@@ -1,0 +1,163 @@
+"""Single config tree for every layer of the framework.
+
+The reference has no config system — constants are module-level globals
+(`clean_data.py:15-23`, `model_tree_train_test.py:26-31`, `cobalt_fast_api.py:19-21`)
+and the hyperparameter space is a literal dict (`model_tree_train_test.py:139-146`).
+Here one dataclass tree covers data paths, mesh shape, model family, HP space and
+CV folds, and is consumed by every layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Where data lives and how it is split.
+
+    Mirrors the S3 bucket/key globals of `clean_data.py:15-23` and
+    `feature_engineering.py:17-20`, generalized to any object-store URI
+    (local path, `file://`, or `s3://`).
+    """
+
+    store_uri: str = "artifacts"
+    raw_key: str = "dataset/1-raw/raw.csv"
+    cleaned_key: str = "dataset/2-intermediate/cleaned_01.csv"
+    tree_key: str = "dataset/2-intermediate/cleaned_02_tree.csv"
+    nn_key: str = "dataset/2-intermediate/cleaned_02_nn.csv"
+    test_fraction: float = 0.2  # model_tree_train_test.py:95-97
+    split_seed: int = 22
+    null_col_threshold: float = 70.0  # clean_data.py:31 — drop cols >70% missing
+    row_null_allowance: int = 20  # feature_engineering.py:66 — drop rows missing >20 cols
+
+
+@dataclasses.dataclass(frozen=True)
+class GBDTConfig:
+    """Histogram-GBDT hyperparameters (XGBoost-equivalent capability).
+
+    Defaults follow XGBClassifier defaults used in `model_tree_train_test.py:111-116`
+    plus the tuned values from BASELINE.md where noted.
+    """
+
+    n_estimators: int = 100
+    max_depth: int = 6
+    learning_rate: float = 0.3
+    subsample: float = 1.0
+    colsample_bytree: float = 1.0
+    gamma: float = 0.0  # min split gain
+    reg_lambda: float = 1.0
+    min_child_weight: float = 1.0
+    n_bins: int = 255  # quantile bins per feature; bin 0 reserved for missing
+    scale_pos_weight: float = 1.0
+    seed: int = 42
+
+    def replace(self, **kw: Any) -> "GBDTConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    """Flax MLP challenger — capability match for the Keras Sequential
+    128/32/16/1 network of `notebooks/04_model_training.ipynb` cell 39."""
+
+    hidden_sizes: Sequence[int] = (128, 32, 16)
+    l2: float = 1e-4
+    learning_rate: float = 1e-3
+    lr_decay_rate: float = 0.9
+    lr_decay_steps: int = 1000
+    weight_decay: float = 1e-4
+    batch_size: int = 1024
+    epochs: int = 30
+    early_stop_patience: int = 5
+    early_stop_metric: str = "val_auc"  # fixes the reference's val_precision-name bug
+    positive_class_weight: float | None = None  # None => balanced (replaces SMOTE)
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class FTTransformerConfig:
+    """FT-Transformer on raw categorical+numeric columns (BASELINE.json configs[3])."""
+
+    d_token: int = 64
+    n_blocks: int = 3
+    n_heads: int = 8
+    ffn_mult: int = 2
+    dropout: float = 0.1
+    learning_rate: float = 1e-3
+    weight_decay: float = 1e-5
+    batch_size: int = 1024
+    epochs: int = 20
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneConfig:
+    """CV x randomized-search fan-out, the TPU equivalent of
+    `RandomizedSearchCV(n_iter=20, cv=StratifiedKFold(3), n_jobs=-1)`
+    (`model_tree_train_test.py:148-159`) — candidates fan out over the device
+    mesh instead of joblib processes."""
+
+    n_iter: int = 20
+    cv_folds: int = 3
+    seed: int = 22
+    scoring: str = "roc_auc"
+    # Search space: model_tree_train_test.py:139-146
+    param_space: Mapping[str, Sequence[Any]] = dataclasses.field(
+        default_factory=lambda: {
+            "n_estimators": (100, 200, 300),
+            "max_depth": (3, 5, 7, 9),
+            "learning_rate": (0.01, 0.05, 0.1),
+            "subsample": (0.8, 1.0),
+            "colsample_bytree": (0.5, 0.8, 1.0),
+            "gamma": (0.0, 1.0, 5.0),
+        }
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class RFEConfig:
+    """Recursive feature elimination to exactly `n_select` features
+    (`model_tree_train_test.py:117-121`), run as masked refits with static
+    shapes so no recompilation happens between steps."""
+
+    n_select: int = 20
+    step: int = 1
+    n_estimators: int = 50  # selector model can be lighter than the final model
+    max_depth: int = 6
+    seed: int = 42
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout. `dp` shards the row axis (data parallel: per-device
+    partial histograms / per-device batch grads, reduced with psum over ICI);
+    `hp` shards the CV-fold x hyperparameter-candidate axis."""
+
+    dp: int = -1  # -1 => all remaining devices
+    hp: int = 1
+    axis_dp: str = "dp"
+    axis_hp: str = "hp"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving contract of `cobalt_fast_api.py` — port, model key, history dir."""
+
+    host: str = "0.0.0.0"
+    port: int = 8000
+    model_key: str = "models/gbdt/model_tree"
+    history_dir: str = "data/3-outputs/history"
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    gbdt: GBDTConfig = dataclasses.field(default_factory=GBDTConfig)
+    mlp: MLPConfig = dataclasses.field(default_factory=MLPConfig)
+    ft: FTTransformerConfig = dataclasses.field(default_factory=FTTransformerConfig)
+    tune: TuneConfig = dataclasses.field(default_factory=TuneConfig)
+    rfe: RFEConfig = dataclasses.field(default_factory=RFEConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)

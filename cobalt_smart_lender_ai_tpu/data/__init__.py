@@ -1,0 +1,26 @@
+"""Columnar ingest + transforms.
+
+Host side does only the irreducibly stringy work (CSV parse, `"36 months"`,
+`"13.5%"`, `"Apr-2005"`); every O(N) numeric transform (log1p, impute, one-hot
+expansion) is a jitted op on a device-resident `(N, F)` matrix.
+"""
+
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.data.clean import clean_raw_frame
+from cobalt_smart_lender_ai_tpu.data.features import (
+    FeatureFrame,
+    engineer_features,
+    prepare_cleaned_frame,
+)
+from cobalt_smart_lender_ai_tpu.data.split import train_test_split_hashed
+from cobalt_smart_lender_ai_tpu.data.synthetic import synthetic_lendingclub_frame
+
+__all__ = [
+    "schema",
+    "clean_raw_frame",
+    "prepare_cleaned_frame",
+    "engineer_features",
+    "FeatureFrame",
+    "train_test_split_hashed",
+    "synthetic_lendingclub_frame",
+]

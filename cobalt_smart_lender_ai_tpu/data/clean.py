@@ -1,0 +1,99 @@
+"""Cleaning stage — capability match for `src/data_preprocessing/clean_data.py`.
+
+Rules implemented (observable behavior of `clean_data_flow`, clean_data.py:87-158):
+  1. drop index-artifact columns (`Unnamed: 0*`)
+  2. drop rows that are missing a value in any near-complete column
+     (columns with < ``row_drop_null_limit`` nulls)
+  3. fill `hardship_status` nulls with "No Hardship"
+  4. parse `term` (" 36 months" -> 36) and `int_rate` ("13.56%" -> 0.1356)
+  5. drop columns with more than ``null_col_threshold`` percent missing
+  6. drop a fixed list of unnecessary columns
+  7. fill missing-means-zero columns with 0
+  8. drop exact duplicate rows
+
+This is intentionally a host-side stage: it is the irreducibly stringy part of
+the pipeline. Everything numeric and O(N) downstream runs on device
+(see `features.py`). Returns a `CleanReport` instead of printing (the reference
+prints `df.info()` to stdout, clean_data.py:107-110).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+import pandas as pd
+
+from cobalt_smart_lender_ai_tpu.data import schema
+
+
+@dataclasses.dataclass
+class CleanReport:
+    n_rows_in: int = 0
+    n_rows_out: int = 0
+    dropped_null_columns: list[str] = dataclasses.field(default_factory=list)
+    dropped_fixed_columns: list[str] = dataclasses.field(default_factory=list)
+    n_duplicates_removed: int = 0
+    n_rows_dropped_near_complete: int = 0
+
+
+def parse_percent(series: pd.Series) -> pd.Series:
+    """'13.56%' -> 0.1356 (clean_data.py:125-127, feature_engineering.py:74)."""
+    if not pd.api.types.is_numeric_dtype(series):
+        series = series.str.replace("%", "", regex=False).astype(float)
+    return series.astype(float) / 100.0
+
+
+def parse_term(series: pd.Series) -> pd.Series:
+    """' 36 months' -> 36 (clean_data.py:121-123)."""
+    if not pd.api.types.is_numeric_dtype(series):
+        return series.str.replace(" months", "", regex=False).astype(int)
+    return series.astype(int)
+
+
+def clean_raw_frame(
+    df: pd.DataFrame,
+    *,
+    null_col_threshold: float = 70.0,
+    row_drop_null_limit: int = 10,
+    unnecessary_cols: Sequence[str] = schema.CLEAN_UNNECESSARY_COLS,
+    fill_zero_cols: Sequence[str] = schema.FILL_ZERO_COLS,
+) -> tuple[pd.DataFrame, CleanReport]:
+    report = CleanReport(n_rows_in=len(df))
+    df = df.drop(columns=list(schema.UNNAMED_COLS), errors="ignore")
+
+    # Rows missing a value in a near-complete column are junk rows
+    # (clean_data.py:113: dropna on columns with < 10 nulls).
+    null_counts = df.isnull().sum()
+    near_complete = null_counts[null_counts < row_drop_null_limit].index
+    before = len(df)
+    df = df.dropna(subset=list(near_complete))
+    report.n_rows_dropped_near_complete = before - len(df)
+
+    if "hardship_status" in df.columns:
+        df = df.assign(hardship_status=df["hardship_status"].fillna("No Hardship"))
+    if "term" in df.columns:
+        df = df.assign(term=parse_term(df["term"]))
+    if "int_rate" in df.columns:
+        df = df.assign(int_rate=parse_percent(df["int_rate"]))
+
+    # Drop columns above the missingness threshold (clean_data.py:31-41).
+    null_pct = df.isnull().mean() * 100.0
+    too_null = null_pct[null_pct > null_col_threshold].index.tolist()
+    report.dropped_null_columns = too_null
+    df = df.drop(columns=too_null)
+
+    present_fixed = [c for c in unnecessary_cols if c in df.columns]
+    report.dropped_fixed_columns = present_fixed
+    df = df.drop(columns=present_fixed)
+
+    fills = {c: 0 for c in fill_zero_cols if c in df.columns}
+    if fills:
+        df = df.fillna(fills)
+
+    before = len(df)
+    df = df.drop_duplicates()
+    report.n_duplicates_removed = before - len(df)
+    report.n_rows_out = len(df)
+    return df.reset_index(drop=True), report

@@ -1,0 +1,59 @@
+"""Deterministic train/test splitting.
+
+Replaces sklearn's `train_test_split(test_size=0.2, random_state=22)`
+(model_tree_train_test.py:95-97) with a stateless per-row hash split: each row
+id is mixed with the seed through an integer hash and lands in test iff the
+hash falls below the test fraction. Stable under re-runs and under appending
+rows (a row's assignment never changes), and computable on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _mix_u32(x: jnp.ndarray, seed: int) -> jnp.ndarray:
+    """splitmix-style avalanching hash on uint32 lanes."""
+    x = x.astype(jnp.uint32) ^ jnp.uint32(seed * 0x9E3779B9 & 0xFFFFFFFF)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def split_mask(n_rows: int, test_fraction: float, seed: int) -> jax.Array:
+    """Boolean mask, True => test row."""
+    h = _mix_u32(jnp.arange(n_rows), seed)
+    threshold = jnp.uint32(min(max(test_fraction, 0.0), 1.0) * 0xFFFFFFFF)
+    return h < threshold
+
+
+def train_test_split_hashed(X, y, *, test_fraction: float = 0.2, seed: int = 22):
+    """Split arrays into (X_train, X_test, y_train, y_test).
+
+    Selection happens host-side once (dynamic shapes are kept out of jit);
+    everything downstream sees static shapes.
+    """
+    mask = np.asarray(split_mask(int(X.shape[0]), test_fraction, seed))
+    Xn, yn = np.asarray(X), np.asarray(y)
+    return (
+        jnp.asarray(Xn[~mask]),
+        jnp.asarray(Xn[mask]),
+        jnp.asarray(yn[~mask]),
+        jnp.asarray(yn[mask]),
+    )
+
+
+def stratified_fold_ids(y: np.ndarray, n_folds: int, seed: int) -> np.ndarray:
+    """Per-row fold assignment, stratified by label — the capability behind
+    `StratifiedKFold(3)` (model_tree_train_test.py:153). Returned as an int
+    vector so CV membership can be expressed as *weights* inside jit (fold k's
+    training weight is `fold_ids != k`), keeping shapes static across folds."""
+    rng = np.random.default_rng(seed)
+    fold = np.zeros(len(y), dtype=np.int32)
+    for cls in np.unique(y):
+        idx = np.flatnonzero(y == cls)
+        idx = rng.permutation(idx)
+        fold[idx] = np.arange(len(idx)) % n_folds
+    return fold

@@ -1,0 +1,6 @@
+"""Model families: histogram GBDT (XGBoost-equivalent), logistic regression,
+Flax MLP challenger, FT-Transformer."""
+
+from cobalt_smart_lender_ai_tpu.models.linear import LogisticRegression
+
+__all__ = ["LogisticRegression"]

@@ -1,0 +1,87 @@
+"""Logistic regression under `jax.jit` — BASELINE.json configs[0] and the
+capability behind sklearn's linear models (SURVEY §2.2).
+
+Fixed-iteration Newton-Raphson with ridge regularization: the Hessian solve is
+an (F+1)x(F+1) dense system, which XLA maps onto the MXU; the per-iteration
+X^T (grad) products are large matmuls. NaNs are mean-imputed on device before
+standardization. Class imbalance handled by `pos_weight` (same semantics as
+XGBoost's `scale_pos_weight`, model_tree_train_test.py:103-106).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LogisticRegressionParams:
+    coef: jax.Array  # (F,)
+    intercept: jax.Array  # ()
+    mean: jax.Array  # (F,) standardization mean
+    scale: jax.Array  # (F,) standardization scale
+
+
+jax.tree_util.register_dataclass(
+    LogisticRegressionParams,
+    data_fields=["coef", "intercept", "mean", "scale"],
+    meta_fields=[],
+)
+
+
+@partial(jax.jit, static_argnames=("n_iter",))
+def _fit(X, y, sample_weight, l2, pos_weight, n_iter: int):
+    mean = jnp.nanmean(X, axis=0)
+    Xf = jnp.where(jnp.isnan(X), mean[None, :], X)
+    scale = jnp.maximum(jnp.std(Xf, axis=0), 1e-8)
+    Xs = (Xf - mean[None, :]) / scale[None, :]
+    n, f = Xs.shape
+    Xb = jnp.concatenate([Xs, jnp.ones((n, 1), Xs.dtype)], axis=1)
+
+    w_row = sample_weight * jnp.where(y > 0.5, pos_weight, 1.0)
+    reg = l2 * jnp.concatenate([jnp.ones((f,)), jnp.zeros((1,))])
+
+    def newton_step(_, beta):
+        logits = Xb @ beta
+        p = jax.nn.sigmoid(logits)
+        g = Xb.T @ (w_row * (p - y)) + reg * beta
+        s = w_row * jnp.maximum(p * (1.0 - p), 1e-6)
+        H = (Xb * s[:, None]).T @ Xb + jnp.diag(reg + 1e-8)
+        return beta - jax.scipy.linalg.solve(H, g, assume_a="pos")
+
+    beta = jax.lax.fori_loop(0, n_iter, newton_step, jnp.zeros((f + 1,), Xs.dtype))
+    return LogisticRegressionParams(beta[:f], beta[f], mean, scale)
+
+
+@jax.jit
+def _predict_proba(params: LogisticRegressionParams, X):
+    Xf = jnp.where(jnp.isnan(X), params.mean[None, :], X)
+    Xs = (Xf - params.mean[None, :]) / params.scale[None, :]
+    return jax.nn.sigmoid(Xs @ params.coef + params.intercept)
+
+
+class LogisticRegression:
+    """sklearn-shaped facade over the jitted kernels."""
+
+    def __init__(self, l2: float = 1.0, pos_weight: float = 1.0, n_iter: int = 25):
+        self.l2 = l2
+        self.pos_weight = pos_weight
+        self.n_iter = n_iter
+        self.params: LogisticRegressionParams | None = None
+
+    def fit(self, X, y, sample_weight=None) -> "LogisticRegression":
+        X = jnp.asarray(X, jnp.float32)
+        y = jnp.asarray(y, jnp.float32)
+        sw = jnp.ones_like(y) if sample_weight is None else jnp.asarray(sample_weight, jnp.float32)
+        self.params = _fit(X, y, sw, jnp.float32(self.l2), jnp.float32(self.pos_weight), self.n_iter)
+        return self
+
+    def predict_proba(self, X) -> jax.Array:
+        assert self.params is not None, "fit first"
+        return _predict_proba(self.params, jnp.asarray(X, jnp.float32))
+
+    def predict(self, X, threshold: float = 0.5) -> jax.Array:
+        return (self.predict_proba(X) >= threshold).astype(jnp.int32)

@@ -1,0 +1,15 @@
+"""Device-side numeric ops: metrics, quantile binning, gradient histograms."""
+
+from cobalt_smart_lender_ai_tpu.ops.metrics import (
+    binary_classification_report,
+    confusion_matrix,
+    precision_recall_f1,
+    roc_auc,
+)
+
+__all__ = [
+    "roc_auc",
+    "confusion_matrix",
+    "precision_recall_f1",
+    "binary_classification_report",
+]

@@ -1,0 +1,110 @@
+"""Classification metrics as jittable device kernels.
+
+The reference delegates to sklearn's Cython metrics
+(`model_tree_train_test.py:169-179`: `roc_auc_score`, `classification_report`,
+`confusion_matrix`). Here they are sort-based / matmul-based XLA programs so
+they can run inside jit — e.g. ROC-AUC evaluated on-device for every
+(fold x candidate) of the tuning fan-out without host round-trips.
+
+All metrics take an optional per-row ``weight`` vector. CV fold membership is
+expressed through weights (0/1 masks), which keeps shapes static under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _weights(y: jax.Array, weight: jax.Array | None) -> jax.Array:
+    return jnp.ones_like(y, dtype=jnp.float32) if weight is None else weight.astype(jnp.float32)
+
+
+@jax.jit
+def _auc_impl(y: jax.Array, scores: jax.Array, w: jax.Array) -> jax.Array:
+    order = jnp.argsort(scores)
+    ss = scores[order]
+    wn_sorted = (w * (1.0 - y))[order]
+    cum_neg = jnp.cumsum(wn_sorted)
+    left = jnp.searchsorted(ss, scores, side="left")
+    right = jnp.searchsorted(ss, scores, side="right")
+    total_neg = cum_neg[-1]
+    neg_below = jnp.where(left > 0, cum_neg[jnp.maximum(left - 1, 0)], 0.0)
+    neg_at = jnp.where(right > 0, cum_neg[jnp.maximum(right - 1, 0)], 0.0) - neg_below
+    wp = w * y
+    total_pos = jnp.sum(wp)
+    pairs_won = jnp.sum(wp * (neg_below + 0.5 * neg_at))
+    return pairs_won / jnp.maximum(total_pos * total_neg, 1e-30)
+
+
+def roc_auc(y_true: jax.Array, scores: jax.Array, weight: jax.Array | None = None) -> jax.Array:
+    """Area under the ROC curve via the rank statistic (exact tie handling,
+    matching `sklearn.metrics.roc_auc_score`). O(N log N) sort + cumsum."""
+    y = y_true.astype(jnp.float32)
+    return _auc_impl(y, scores.astype(jnp.float32), _weights(y, weight))
+
+
+def confusion_matrix(
+    y_true: jax.Array,
+    y_pred: jax.Array,
+    n_classes: int = 2,
+    weight: jax.Array | None = None,
+) -> jax.Array:
+    """(n_classes, n_classes) matrix, rows = actual, cols = predicted —
+    as one one-hot matmul so it lands on the MXU."""
+    w = _weights(y_true.astype(jnp.float32), weight)
+    oh_true = jax.nn.one_hot(y_true.astype(jnp.int32), n_classes, dtype=jnp.float32)
+    oh_pred = jax.nn.one_hot(y_pred.astype(jnp.int32), n_classes, dtype=jnp.float32)
+    return (oh_true * w[:, None]).T @ oh_pred
+
+
+def precision_recall_f1(cm: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-class (precision, recall, f1, support) from a confusion matrix."""
+    tp = jnp.diagonal(cm)
+    support = cm.sum(axis=1)
+    pred_count = cm.sum(axis=0)
+    precision = tp / jnp.maximum(pred_count, 1e-30)
+    recall = tp / jnp.maximum(support, 1e-30)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-30)
+    return precision, recall, f1, support
+
+
+def binary_classification_report(
+    y_true: jax.Array, y_pred: jax.Array, weight: jax.Array | None = None
+) -> dict:
+    """Dict with the exact schema of sklearn's
+    `classification_report(output_dict=True)` (model_tree_train_test.py:174),
+    preserved because it is persisted verbatim into `metrics.json`
+    (model_tree_train_test.py:235-242)."""
+    cm = confusion_matrix(y_true, y_pred, 2, weight)
+    precision, recall, f1, support = precision_recall_f1(cm)
+    total = cm.sum()
+    accuracy = jnp.diagonal(cm).sum() / jnp.maximum(total, 1e-30)
+
+    def _cls(i: int) -> dict:
+        return {
+            "precision": float(precision[i]),
+            "recall": float(recall[i]),
+            "f1-score": float(f1[i]),
+            "support": float(support[i]),
+        }
+
+    sup = jnp.asarray(support, dtype=jnp.float32)
+    wavg = lambda v: float(jnp.sum(v * sup) / jnp.maximum(jnp.sum(sup), 1e-30))
+    return {
+        "0": _cls(0),
+        "1": _cls(1),
+        "accuracy": float(accuracy),
+        "macro avg": {
+            "precision": float(precision.mean()),
+            "recall": float(recall.mean()),
+            "f1-score": float(f1.mean()),
+            "support": float(support.sum()),
+        },
+        "weighted avg": {
+            "precision": wavg(precision),
+            "recall": wavg(recall),
+            "f1-score": wavg(f1),
+            "support": float(support.sum()),
+        },
+    }

@@ -1,0 +1,58 @@
+"""Test harness: force an 8-device virtual CPU backend before JAX is imported.
+
+This is the standard JAX fake-backend trick (SURVEY §4c): all sharding /
+collective / fan-out code paths run in CI on a single CPU host exactly as they
+would over 8 TPU chips, so the mesh-parallel code is exercised on every test
+run without pod hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def raw_frame():
+    from cobalt_smart_lender_ai_tpu.data.synthetic import synthetic_lendingclub_frame
+
+    return synthetic_lendingclub_frame(n_rows=4000, seed=7)
+
+
+@pytest.fixture(scope="session")
+def engineered(raw_frame):
+    """(tree_ff, nn_ff, plan) built once per session from the synthetic raw frame."""
+    from cobalt_smart_lender_ai_tpu.data.clean import clean_raw_frame
+    from cobalt_smart_lender_ai_tpu.data.features import (
+        engineer_features,
+        prepare_cleaned_frame,
+    )
+
+    cleaned, _ = clean_raw_frame(raw_frame)
+    prepared = prepare_cleaned_frame(cleaned)
+    return engineer_features(prepared)
+
+
+@pytest.fixture(scope="session")
+def train_test(engineered):
+    """Leakage-dropped tree matrix split into train/test numpy arrays."""
+    from cobalt_smart_lender_ai_tpu.data.features import drop_training_leakage
+    from cobalt_smart_lender_ai_tpu.data.split import train_test_split_hashed
+
+    tree_ff, _, _ = engineered
+    ff = drop_training_leakage(tree_ff)
+    X_train, X_test, y_train, y_test = train_test_split_hashed(
+        ff.X, ff.y, test_fraction=0.2, seed=22
+    )
+    return (
+        np.asarray(X_train), np.asarray(X_test),
+        np.asarray(y_train), np.asarray(y_test),
+        ff.feature_names,
+    )

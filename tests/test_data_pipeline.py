@@ -1,0 +1,160 @@
+"""Golden tests for the cleaning + feature-engineering rules (SURVEY §4a)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.data.clean import (
+    clean_raw_frame,
+    parse_percent,
+    parse_term,
+)
+from cobalt_smart_lender_ai_tpu.data.features import (
+    drop_training_leakage,
+    engineer_features,
+    prepare_cleaned_frame,
+)
+from cobalt_smart_lender_ai_tpu.data.split import (
+    split_mask,
+    stratified_fold_ids,
+    train_test_split_hashed,
+)
+
+
+def test_parse_term_and_percent():
+    assert parse_term(pd.Series([" 36 months", " 60 months"])).tolist() == [36, 60]
+    out = parse_percent(pd.Series(["13.56%", "7.00%"]))
+    np.testing.assert_allclose(out.to_numpy(), [0.1356, 0.07])
+
+
+def test_clean_drops_unnamed_and_sparse_and_duplicates(raw_frame):
+    cleaned, report = clean_raw_frame(raw_frame)
+    assert "Unnamed: 0" not in cleaned.columns
+    assert not any(c.startswith("junk_sparse") for c in cleaned.columns)
+    for c in schema.CLEAN_UNNECESSARY_COLS:
+        assert c not in cleaned.columns
+    assert report.n_duplicates_removed >= 1
+    assert cleaned.duplicated().sum() == 0
+    # missing-means-zero columns are fully filled
+    for c in schema.FILL_ZERO_COLS:
+        assert cleaned[c].isnull().sum() == 0
+    # term / int_rate parsed to numerics
+    assert np.issubdtype(cleaned["term"].dtype, np.number)
+    assert cleaned["int_rate"].between(0, 1).all()
+    assert cleaned["hardship_status"].isnull().sum() == 0
+
+
+def test_prepare_creates_label_and_numeric_conversions(raw_frame):
+    cleaned, _ = clean_raw_frame(raw_frame)
+    prepared = prepare_cleaned_frame(cleaned)
+    # leakage + useless columns are gone (feature_engineering.py:56-63)
+    for c in schema.FE_LEAKAGE_COLS + schema.FE_USELESS_COLS:
+        assert c not in prepared.columns
+    assert schema.LABEL_COL in prepared.columns
+    assert set(np.unique(prepared[schema.LABEL_COL])) <= {0, 1}
+    assert "emp_length_num" in prepared.columns
+    assert prepared["emp_length_num"].max() <= 10
+    assert "earliest_cr_line_days" in prepared.columns
+    assert prepared["earliest_cr_line_days"].min() > 0
+    assert prepared["revol_util"].dtype.kind == "f"
+
+
+def test_label_map_matches_reference():
+    statuses = list(schema.LOAN_STATUS_MAP)
+    df = pd.DataFrame({"loan_status": statuses})
+    out = prepare_cleaned_frame(df)
+    expected = [schema.LOAN_STATUS_MAP[s] for s in statuses]
+    assert out[schema.LABEL_COL].tolist() == expected
+
+
+def test_engineer_tree_one_hot_and_log(raw_frame):
+    cleaned, _ = clean_raw_frame(raw_frame)
+    prepared = prepare_cleaned_frame(cleaned)
+    tree_ff, nn_ff, plan = engineer_features(prepared)
+
+    # one-hot columns exist with drop_first semantics: first sorted category absent
+    assert "grade_B" in tree_ff.feature_names
+    assert "grade_A" not in tree_ff.feature_names
+    assert "hardship_status_No Hardship" in tree_ff.feature_names
+    assert "application_type_Joint App" in tree_ff.feature_names
+
+    # one-hot block values are 0/1 and rows sum to <= 1 per categorical
+    gcols = [i for i, n in enumerate(tree_ff.feature_names) if n.startswith("grade_")]
+    gblock = np.asarray(tree_ff.X[:, gcols])
+    assert set(np.unique(gblock)) <= {0.0, 1.0}
+    assert (gblock.sum(axis=1) <= 1).all()
+
+    # log1p applied to a strictly-positive skewed column: values shrink
+    li = tree_ff.feature_names.index("annual_inc")
+    raw_inc = prepared["annual_inc"].to_numpy()
+    np.testing.assert_allclose(
+        np.asarray(tree_ff.X[:, li]), np.log1p(raw_inc), rtol=1e-4
+    )
+
+    # a non-log column is untouched
+    ti = tree_ff.feature_names.index("term")
+    np.testing.assert_allclose(
+        np.asarray(tree_ff.X[:, ti]), prepared["term"].to_numpy(), rtol=1e-6
+    )
+
+
+def test_engineer_nn_impute_and_indicators(raw_frame):
+    cleaned, _ = clean_raw_frame(raw_frame)
+    prepared = prepare_cleaned_frame(cleaned)
+    _, nn_ff, plan = engineer_features(prepared)
+    Xnn = np.asarray(nn_ff.X)
+    assert not np.isnan(Xnn).any()
+    # indicator exists for a column with missingness
+    assert "mths_since_last_delinq_NA" in nn_ff.feature_names
+    assert "no_income" in nn_ff.feature_names
+    assert "dti_NA" in nn_ff.feature_names
+    # indicator agrees with raw missingness
+    ind = Xnn[:, nn_ff.feature_names.index("mths_since_last_delinq_NA")]
+    raw_nan = prepared["mths_since_last_delinq"].isnull().to_numpy()
+    np.testing.assert_array_equal(ind.astype(bool), raw_nan)
+    # imputed value equals the median of the log-transformed column
+    col = np.log1p(prepared["mths_since_last_delinq"].to_numpy())
+    med = np.nanmedian(col)
+    filled = Xnn[:, nn_ff.feature_names.index("mths_since_last_delinq")]
+    np.testing.assert_allclose(filled[raw_nan], med, rtol=1e-5)
+    # categorical label codes are integral and in range
+    gcol = Xnn[:, nn_ff.feature_names.index("grade")]
+    assert gcol.min() >= 0 and gcol.max() < len(plan.categorical_vocab["grade"]) + 1
+
+
+def test_drop_training_leakage(engineered):
+    tree_ff, _, _ = engineered
+    ff = drop_training_leakage(tree_ff)
+    for c in schema.TRAIN_LEAKAGE_COLS:
+        assert c not in ff.feature_names
+    assert ff.X.shape[1] == len(ff.feature_names)
+
+
+def test_split_deterministic_and_sized():
+    m1 = np.asarray(split_mask(10_000, 0.2, 22))
+    m2 = np.asarray(split_mask(10_000, 0.2, 22))
+    np.testing.assert_array_equal(m1, m2)
+    assert abs(m1.mean() - 0.2) < 0.02
+    # stable under growth: first 10k assignments unchanged at 20k rows
+    m3 = np.asarray(split_mask(20_000, 0.2, 22))
+    np.testing.assert_array_equal(m1, m3[:10_000])
+    # different seed → different split
+    assert not np.array_equal(m1, np.asarray(split_mask(10_000, 0.2, 23)))
+
+
+def test_split_arrays_shapes():
+    X = np.arange(200, dtype=np.float32).reshape(100, 2)
+    y = (np.arange(100) % 2).astype(np.float32)
+    X_tr, X_te, y_tr, y_te = train_test_split_hashed(X, y, test_fraction=0.3, seed=1)
+    assert X_tr.shape[0] + X_te.shape[0] == 100
+    assert y_tr.shape[0] == X_tr.shape[0]
+
+
+def test_stratified_folds_balance():
+    y = np.array([0] * 90 + [1] * 9)
+    folds = stratified_fold_ids(y, 3, seed=0)
+    for k in range(3):
+        sel = folds == k
+        assert y[sel].sum() == 3  # positives evenly spread
+        assert sel.sum() == 33

@@ -1,0 +1,65 @@
+"""Numeric parity of jitted metrics vs sklearn (SURVEY §4b)."""
+
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from cobalt_smart_lender_ai_tpu.ops.metrics import (
+    binary_classification_report,
+    confusion_matrix,
+    roc_auc,
+)
+
+
+@pytest.fixture(scope="module")
+def scored():
+    rng = np.random.default_rng(0)
+    n = 3000
+    y = (rng.random(n) < 0.2).astype(np.float32)
+    # correlated, with heavy ties to stress tie handling
+    s = np.round(y * 0.8 + rng.normal(0, 0.6, n), 1).astype(np.float32)
+    return y, s
+
+
+def test_roc_auc_matches_sklearn(scored):
+    y, s = scored
+    ours = float(roc_auc(y, s))
+    ref = skm.roc_auc_score(y, s)
+    assert abs(ours - ref) < 1e-5
+
+
+def test_roc_auc_weighted_matches_sklearn(scored):
+    y, s = scored
+    rng = np.random.default_rng(1)
+    w = rng.random(len(y)).astype(np.float32)
+    ours = float(roc_auc(y, s, w))
+    ref = skm.roc_auc_score(y, s, sample_weight=w)
+    assert abs(ours - ref) < 1e-5
+
+
+def test_roc_auc_masked_equals_subset(scored):
+    y, s = scored
+    mask = (np.arange(len(y)) % 3 == 0).astype(np.float32)
+    ours = float(roc_auc(y, s, mask))
+    ref = skm.roc_auc_score(y[mask > 0], s[mask > 0])
+    assert abs(ours - ref) < 1e-5
+
+
+def test_confusion_matrix_matches_sklearn(scored):
+    y, s = scored
+    pred = (s > 0.4).astype(np.float32)
+    ours = np.asarray(confusion_matrix(y, pred))
+    ref = skm.confusion_matrix(y, pred)
+    np.testing.assert_allclose(ours, ref)
+
+
+def test_classification_report_schema_and_values(scored):
+    y, s = scored
+    pred = (s > 0.4).astype(np.float32)
+    ours = binary_classification_report(y, pred)
+    ref = skm.classification_report(y, pred, output_dict=True)
+    for cls in ("0", "1"):
+        for k in ("precision", "recall", "f1-score", "support"):
+            assert abs(ours[cls][k] - ref[f"{cls}.0"][k]) < 1e-5, (cls, k)
+    assert abs(ours["accuracy"] - ref["accuracy"]) < 1e-5
+    assert abs(ours["weighted avg"]["f1-score"] - ref["weighted avg"]["f1-score"]) < 1e-5
