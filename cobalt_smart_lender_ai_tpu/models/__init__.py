@@ -1,6 +1,24 @@
 """Model families: histogram GBDT (XGBoost-equivalent), logistic regression,
 Flax MLP challenger, FT-Transformer."""
 
+from cobalt_smart_lender_ai_tpu.models.gbdt import (
+    Forest,
+    GBDTClassifier,
+    GBDTHyperparams,
+    attach_float_thresholds,
+    fit_binned,
+    gain_importances,
+    predict_margin,
+)
 from cobalt_smart_lender_ai_tpu.models.linear import LogisticRegression
 
-__all__ = ["LogisticRegression"]
+__all__ = [
+    "Forest",
+    "GBDTClassifier",
+    "GBDTHyperparams",
+    "attach_float_thresholds",
+    "fit_binned",
+    "gain_importances",
+    "predict_margin",
+    "LogisticRegression",
+]

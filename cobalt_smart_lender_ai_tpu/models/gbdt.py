@@ -1,0 +1,424 @@
+"""Histogram gradient-boosted decision trees, fully under `jax.jit`.
+
+This is the TPU-native re-provision of the XGBoost C++ core the reference
+leans on for its production model (`model_tree_train_test.py:111-179`,
+`cobalt_fast_api.py:90-91`): binned features, per-level gradient histograms,
+split search with learned missing-value direction, logistic objective with
+`scale_pos_weight`, row subsampling and per-tree column sampling.
+
+Design notes (TPU-first, not a port):
+
+- **Complete-tree tensors.** Every tree is a complete binary tree of static
+  depth ``depth_cap``; nodes that should not split get a *trivial* split
+  (threshold ``n_bins - 1`` + missing-left, so every row routes left). That
+  keeps all shapes static, so the whole `fit` is one XLA program — a
+  `lax.scan` over trees with the level loop unrolled.
+- **Every hyperparameter is traced**, including ``n_estimators`` (extra trees
+  contribute zero leaf values) and ``max_depth`` (deeper levels forced
+  trivial). A whole RandomizedSearchCV candidate grid therefore runs as one
+  `vmap` — no recompilation per candidate — which is what lets CV x HPO fan
+  out over the device mesh in `parallel/tune.py` instead of joblib processes
+  (`model_tree_train_test.py:148-159`).
+- **Sample-weight unification.** Fold membership (CV), row subsampling and
+  `scale_pos_weight` all enter through one per-row weight vector, keeping
+  shapes static under vmap.
+- **One histogram pass per level** computes every node's (feature, bin)
+  gradient sums via a joint segment-sum (`ops/histogram.py`); level-wise
+  growth does exactly ``depth`` passes over the data per tree.
+- Trees store both the bin threshold (training/binned predict) and the float
+  threshold (serving predict on raw feature vectors, no binning round-trip).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cobalt_smart_lender_ai_tpu.config import GBDTConfig
+from cobalt_smart_lender_ai_tpu.ops.binning import (
+    BinSpec,
+    compute_bin_edges,
+    float_threshold,
+    transform,
+)
+from cobalt_smart_lender_ai_tpu.ops.histogram import gradient_histogram
+
+
+@dataclasses.dataclass(frozen=True)
+class GBDTHyperparams:
+    """Traced (vmappable) hyperparameters. Structural caps live in the jit's
+    static args instead (`n_trees_cap`, `depth_cap`, `n_bins`)."""
+
+    learning_rate: jax.Array
+    gamma: jax.Array
+    reg_lambda: jax.Array
+    min_child_weight: jax.Array
+    scale_pos_weight: jax.Array
+    subsample: jax.Array
+    colsample_bytree: jax.Array
+    n_estimators: jax.Array  # int32 <= n_trees_cap
+    max_depth: jax.Array  # int32 <= depth_cap
+
+    @staticmethod
+    def from_config(cfg: GBDTConfig) -> "GBDTHyperparams":
+        f = jnp.float32
+        return GBDTHyperparams(
+            learning_rate=f(cfg.learning_rate),
+            gamma=f(cfg.gamma),
+            reg_lambda=f(cfg.reg_lambda),
+            min_child_weight=f(cfg.min_child_weight),
+            scale_pos_weight=f(cfg.scale_pos_weight),
+            subsample=f(cfg.subsample),
+            colsample_bytree=f(cfg.colsample_bytree),
+            n_estimators=jnp.int32(cfg.n_estimators),
+            max_depth=jnp.int32(cfg.max_depth),
+        )
+
+
+jax.tree_util.register_dataclass(
+    GBDTHyperparams,
+    data_fields=[
+        "learning_rate",
+        "gamma",
+        "reg_lambda",
+        "min_child_weight",
+        "scale_pos_weight",
+        "subsample",
+        "colsample_bytree",
+        "n_estimators",
+        "max_depth",
+    ],
+    meta_fields=[],
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Forest:
+    """Tensorized forest: ``T`` complete trees of depth ``depth``.
+
+    Internal nodes are heap-indexed ``0 .. 2^depth - 2``; leaves are the heap
+    slots ``2^depth - 1 .. 2^(depth+1) - 2`` (stored separately). ``cover`` is
+    the training-row count reaching each heap slot (internal nodes then
+    leaves), which TreeSHAP's path-dependent algorithm consumes.
+    """
+
+    feature: jax.Array  # (T, I) int32
+    thr_bin: jax.Array  # (T, I) int32
+    thr_float: jax.Array  # (T, I) float32
+    missing_left: jax.Array  # (T, I) bool
+    gain: jax.Array  # (T, I) float32 — 0 for trivial (non-)splits
+    cover: jax.Array  # (T, I + L) float32
+    leaf_value: jax.Array  # (T, L) float32 — already scaled by learning rate
+    depth: int = dataclasses.field(metadata={"static": True})
+
+    @property
+    def n_trees(self) -> int:
+        return self.feature.shape[0]
+
+    @property
+    def n_internal(self) -> int:
+        return self.feature.shape[1]
+
+    @property
+    def n_leaves(self) -> int:
+        return self.leaf_value.shape[1]
+
+    def is_real_split(self) -> jax.Array:
+        """(T, I) bool — True where the node performs an actual split."""
+        return self.gain > 0.0
+
+
+jax.tree_util.register_dataclass(
+    Forest,
+    data_fields=[
+        "feature",
+        "thr_bin",
+        "thr_float",
+        "missing_left",
+        "gain",
+        "cover",
+        "leaf_value",
+    ],
+    meta_fields=["depth"],
+)
+
+
+def _split_gain(GL, HL, GR, HR, Gt, Ht, reg_lambda, gamma):
+    """XGBoost structure-score gain (xgboost docs; model_tree_train_test.py
+    relies on it via the C++ core)."""
+    return 0.5 * (
+        GL * GL / (HL + reg_lambda)
+        + GR * GR / (HR + reg_lambda)
+        - Gt * Gt / (Ht + reg_lambda)
+    ) - gamma
+
+
+@partial(jax.jit, static_argnames=("n_trees_cap", "depth_cap", "n_bins"))
+def fit_binned(
+    bins: jax.Array,  # (N, F) uint8/int32
+    y: jax.Array,  # (N,) {0,1}
+    sample_weight: jax.Array,  # (N,) float32 — CV fold masks ride here
+    feature_mask: jax.Array,  # (F,) bool — RFE / colsample support
+    hp: GBDTHyperparams,
+    rng: jax.Array,
+    *,
+    n_trees_cap: int,
+    depth_cap: int,
+    n_bins: int,
+) -> Forest:
+    """Train a forest on pre-binned features. One XLA program: scan over
+    trees, unrolled level loop, one histogram pass per level."""
+    N, F = bins.shape
+    n_internal = 2**depth_cap - 1
+    n_leaves = 2**depth_cap
+    y = y.astype(jnp.float32)
+    base_w = sample_weight.astype(jnp.float32) * jnp.where(
+        y > 0.5, hp.scale_pos_weight, 1.0
+    )
+    row_ids = jnp.arange(N, dtype=jnp.int32)
+
+    def build_tree(margin, tree_idx):
+        key = jax.random.fold_in(rng, tree_idx)
+        k_row, k_col = jax.random.split(key)
+
+        # Row subsampling (xgboost `subsample`) as a Bernoulli weight mask.
+        sub = (jax.random.uniform(k_row, (N,)) < hp.subsample).astype(jnp.float32)
+        w = base_w * sub
+        p = jax.nn.sigmoid(margin)
+        g = w * (p - y)
+        h = w * jnp.maximum(p * (1.0 - p), 1e-16)
+
+        # Per-tree column sampling among the *available* (unmasked) features:
+        # keep exactly round(colsample * n_available), like xgboost samples
+        # among the columns it was given. Masked features rank last.
+        u = jnp.where(feature_mask, jax.random.uniform(k_col, (F,)), jnp.inf)
+        ranks = jnp.argsort(jnp.argsort(u))
+        n_avail = jnp.sum(feature_mask).astype(jnp.float32)
+        n_keep = jnp.maximum(1, jnp.round(hp.colsample_bytree * n_avail)).astype(
+            jnp.int32
+        )
+        cmask = (ranks < n_keep) & feature_mask
+
+        node = jnp.zeros((N,), jnp.int32)
+        feats = jnp.zeros((n_internal,), jnp.int32)
+        thrs = jnp.full((n_internal,), n_bins - 1, jnp.int32)
+        mls = jnp.ones((n_internal,), bool)
+        gains = jnp.zeros((n_internal,), jnp.float32)
+        covers = jnp.zeros((n_internal + n_leaves,), jnp.float32)
+
+        for level in range(depth_cap):
+            n_nodes = 2**level
+            offset = n_nodes - 1
+            local = node - offset
+            hist = gradient_histogram(
+                bins, local, g, h, n_nodes=n_nodes, n_bins=n_bins
+            )  # (n_nodes, F, B, 2)
+            covers = covers.at[offset : offset + n_nodes].set(
+                jax.ops.segment_sum(
+                    jnp.ones((N,), jnp.float32), local, num_segments=n_nodes
+                )
+            )
+            miss = hist[:, :, 0, :]  # (n_nodes, F, 2) missing-bucket sums
+            cum = jnp.cumsum(hist[:, :, 1:, :], axis=2)  # (n_nodes, F, B-1, 2)
+            tot = cum[:, :, -1, :] + miss  # node totals, replicated over F
+            # Candidate thresholds t = 1..B-2 (cum index t-1). The top
+            # candidate t = B-2 puts all non-missing left, missing right.
+            GL = cum[..., :-1, 0]
+            HL = cum[..., :-1, 1]
+            Gm, Hm = miss[..., 0][:, :, None], miss[..., 1][:, :, None]
+            Gt, Ht = tot[..., 0][:, :, None], tot[..., 1][:, :, None]
+
+            def masked_gain(GLv, HLv):
+                GRv, HRv = Gt - GLv, Ht - HLv
+                ok = (HLv >= hp.min_child_weight) & (HRv >= hp.min_child_weight)
+                ok = ok & cmask[None, :, None]
+                gv = _split_gain(GLv, HLv, GRv, HRv, Gt, Ht, hp.reg_lambda, hp.gamma)
+                return jnp.where(ok, gv, -jnp.inf)
+
+            gain_ml = masked_gain(GL + Gm, HL + Hm)  # missing goes left
+            gain_mr = masked_gain(GL, HL)  # missing goes right
+            go_ml = gain_ml >= gain_mr
+            cand = jnp.maximum(gain_ml, gain_mr)  # (n_nodes, F, B-2)
+            flat = cand.reshape(n_nodes, -1)
+            best = jnp.argmax(flat, axis=1)
+            best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+            bf = (best // (n_bins - 2)).astype(jnp.int32)
+            bt = (best % (n_bins - 2)).astype(jnp.int32) + 1
+            bml = jnp.take_along_axis(
+                go_ml.reshape(n_nodes, -1), best[:, None], axis=1
+            )[:, 0]
+
+            do_split = (best_gain > 0.0) & (level < hp.max_depth)
+            feat_lvl = jnp.where(do_split, bf, 0)
+            thr_lvl = jnp.where(do_split, bt, n_bins - 1)
+            ml_lvl = jnp.where(do_split, bml, True)
+            feats = feats.at[offset : offset + n_nodes].set(feat_lvl)
+            thrs = thrs.at[offset : offset + n_nodes].set(thr_lvl)
+            mls = mls.at[offset : offset + n_nodes].set(ml_lvl)
+            gains = gains.at[offset : offset + n_nodes].set(
+                jnp.where(do_split, best_gain, 0.0)
+            )
+
+            b_row = bins[row_ids, feat_lvl[local]].astype(jnp.int32)
+            go_left = jnp.where(b_row == 0, ml_lvl[local], b_row <= thr_lvl[local])
+            node = 2 * node + 1 + (1 - go_left.astype(jnp.int32))
+
+        leaf_local = node - (2**depth_cap - 1)
+        sums = jax.ops.segment_sum(
+            jnp.stack([g, h, jnp.ones_like(g)], axis=-1),
+            leaf_local,
+            num_segments=n_leaves,
+        )
+        covers = covers.at[n_internal:].set(sums[:, 2])
+        tree_on = (tree_idx < hp.n_estimators).astype(jnp.float32)
+        leaf_val = -sums[:, 0] / (sums[:, 1] + hp.reg_lambda) * hp.learning_rate
+        leaf_val = jnp.where(sums[:, 1] > 0, leaf_val, 0.0) * tree_on
+        gains = gains * tree_on  # inert trees must not pollute gain importances
+        margin = margin + leaf_val[leaf_local]
+        return margin, (feats, thrs, mls, gains, covers, leaf_val)
+
+    _, (feats, thrs, mls, gains, covers, leaf_vals) = jax.lax.scan(
+        build_tree,
+        jnp.zeros((N,), jnp.float32),
+        jnp.arange(n_trees_cap, dtype=jnp.int32),
+    )
+    return Forest(
+        feature=feats,
+        thr_bin=thrs,
+        thr_float=jnp.zeros_like(thrs, jnp.float32),  # filled by attach_float_thresholds
+        missing_left=mls,
+        gain=gains,
+        cover=covers,
+        leaf_value=leaf_vals,
+        depth=depth_cap,
+    )
+
+
+def attach_float_thresholds(forest: Forest, spec: BinSpec) -> Forest:
+    """Resolve bin thresholds into raw-feature-space thresholds so serving can
+    predict on unbinned rows. Trivial splits resolve to +inf (all-left)."""
+    return dataclasses.replace(
+        forest, thr_float=float_threshold(spec, forest.feature, forest.thr_bin)
+    )
+
+
+@partial(jax.jit, static_argnames=("use_binned",))
+def predict_margin(forest: Forest, X: jax.Array, use_binned: bool = False) -> jax.Array:
+    """Sum-of-trees margin (log-odds). ``X`` is ``(N, F)`` — raw floats by
+    default (serving path: float thresholds, NaN follows the learned missing
+    direction), or pre-binned indices with ``use_binned=True``."""
+    N = X.shape[0]
+    row_ids = jnp.arange(N, dtype=jnp.int32)
+
+    def tree_step(margin, tree):
+        feats, thr_bin, thr_float, ml, leaf_value = tree
+        node = jnp.zeros((N,), jnp.int32)
+        for _ in range(forest.depth):
+            f = feats[node]
+            x = X[row_ids, f]
+            if use_binned:
+                b = x.astype(jnp.int32)
+                go_left = jnp.where(b == 0, ml[node], b <= thr_bin[node])
+            else:
+                go_left = jnp.where(jnp.isnan(x), ml[node], x <= thr_float[node])
+            node = 2 * node + 1 + (1 - go_left.astype(jnp.int32))
+        leaf = node - (2**forest.depth - 1)
+        return margin + leaf_value[leaf], None
+
+    margin, _ = jax.lax.scan(
+        tree_step,
+        jnp.zeros((N,), jnp.float32),
+        (
+            forest.feature,
+            forest.thr_bin,
+            forest.thr_float,
+            forest.missing_left,
+            forest.leaf_value,
+        ),
+    )
+    return margin
+
+
+def gain_importances(forest: Forest, n_features: int) -> tuple[jax.Array, jax.Array]:
+    """(total_gain, n_splits) per feature — backs the booster "gain" scores
+    that `/feature_importance_bulk` serves (cobalt_fast_api.py:128-143)."""
+    real = forest.is_real_split()
+    flat_feat = forest.feature.reshape(-1)
+    flat_gain = jnp.where(real, forest.gain, 0.0).reshape(-1)
+    total_gain = jax.ops.segment_sum(flat_gain, flat_feat, num_segments=n_features)
+    n_splits = jax.ops.segment_sum(
+        real.reshape(-1).astype(jnp.float32), flat_feat, num_segments=n_features
+    )
+    return total_gain, n_splits
+
+
+class GBDTClassifier:
+    """sklearn/xgboost-shaped facade over the jitted kernels — the drop-in for
+    `XGBClassifier` in the reference's training script."""
+
+    def __init__(self, config: GBDTConfig | None = None, **overrides):
+        cfg = config or GBDTConfig()
+        if overrides:
+            cfg = cfg.replace(**overrides)
+        self.config = cfg
+        self.forest: Forest | None = None
+        self.bin_spec: BinSpec | None = None
+        self.n_features_: int | None = None
+
+    def fit(self, X, y, sample_weight=None, feature_mask=None) -> "GBDTClassifier":
+        X = jnp.asarray(X, jnp.float32)
+        y = jnp.asarray(y)
+        N, F = X.shape
+        self.n_features_ = F
+        cfg = self.config
+        self.bin_spec = compute_bin_edges(X, n_bins=cfg.n_bins)
+        bins = transform(self.bin_spec, X)
+        sw = (
+            jnp.ones((N,), jnp.float32)
+            if sample_weight is None
+            else jnp.asarray(sample_weight, jnp.float32)
+        )
+        fm = (
+            jnp.ones((F,), bool)
+            if feature_mask is None
+            else jnp.asarray(feature_mask, bool)
+        )
+        forest = fit_binned(
+            bins,
+            y,
+            sw,
+            fm,
+            GBDTHyperparams.from_config(cfg),
+            jax.random.PRNGKey(cfg.seed),
+            n_trees_cap=cfg.n_estimators,
+            depth_cap=cfg.max_depth,
+            n_bins=cfg.n_bins,
+        )
+        self.forest = attach_float_thresholds(forest, self.bin_spec)
+        return self
+
+    def predict_margin(self, X) -> jax.Array:
+        assert self.forest is not None, "fit first"
+        return predict_margin(self.forest, jnp.asarray(X, jnp.float32))
+
+    def predict_proba(self, X) -> jax.Array:
+        """(N, 2) probabilities, matching `XGBClassifier.predict_proba`."""
+        p1 = jax.nn.sigmoid(self.predict_margin(X))
+        return jnp.stack([1.0 - p1, p1], axis=1)
+
+    def predict(self, X, threshold: float = 0.5) -> jax.Array:
+        return (self.predict_proba(X)[:, 1] >= threshold).astype(jnp.int32)
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        """Normalized total-gain importances (xgboost's default for plotting
+        at model_tree_train_test.py:197-210)."""
+        assert self.forest is not None and self.n_features_ is not None
+        total_gain, _ = gain_importances(self.forest, self.n_features_)
+        tg = np.asarray(total_gain)
+        s = tg.sum()
+        return tg / s if s > 0 else tg

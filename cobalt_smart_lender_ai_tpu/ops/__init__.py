@@ -1,5 +1,11 @@
 """Device-side numeric ops: metrics, quantile binning, gradient histograms."""
 
+from cobalt_smart_lender_ai_tpu.ops.binning import (
+    BinSpec,
+    compute_bin_edges,
+    transform,
+)
+from cobalt_smart_lender_ai_tpu.ops.histogram import gradient_histogram
 from cobalt_smart_lender_ai_tpu.ops.metrics import (
     binary_classification_report,
     confusion_matrix,
@@ -8,6 +14,10 @@ from cobalt_smart_lender_ai_tpu.ops.metrics import (
 )
 
 __all__ = [
+    "BinSpec",
+    "compute_bin_edges",
+    "transform",
+    "gradient_histogram",
     "roc_auc",
     "confusion_matrix",
     "precision_recall_f1",

@@ -1,0 +1,39 @@
+"""Gradient/hessian histograms — the hot op of histogram GBDT.
+
+XGBoost builds per-node (feature, bin) gradient histograms in multithreaded
+C++ (`hist` method); this is the XLA equivalent: one fused segment-sum over a
+joint (node, feature, bin) index computes the histograms of *every* node of a
+tree level in a single device pass. Under a `dp`-sharded mesh each device
+builds partial histograms of its row shard and a `psum` over ICI reduces them
+(see `parallel/sharded.py`), which is the GBDT analog of data-parallel
+gradient all-reduce.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins"))
+def gradient_histogram(
+    bins: jax.Array,  # (N, F) uint8/int32 bin indices
+    node_local: jax.Array,  # (N,) int32 — row's node index within the level, [0, n_nodes)
+    g: jax.Array,  # (N,) float32 gradients (already sample-weighted)
+    h: jax.Array,  # (N,) float32 hessians
+    *,
+    n_nodes: int,
+    n_bins: int,
+) -> jax.Array:
+    """Return ``(n_nodes, F, n_bins, 2)`` sums of (g, h) per bucket."""
+    N, F = bins.shape
+    feat_ids = jnp.arange(F, dtype=jnp.int32)[None, :]
+    seg = (node_local.astype(jnp.int32)[:, None] * F + feat_ids) * n_bins + bins.astype(
+        jnp.int32
+    )  # (N, F)
+    data = jnp.stack([g, h], axis=-1)  # (N, 2)
+    data = jnp.broadcast_to(data[:, None, :], (N, F, 2)).reshape(N * F, 2)
+    out = jax.ops.segment_sum(data, seg.reshape(-1), num_segments=n_nodes * F * n_bins)
+    return out.reshape(n_nodes, F, n_bins, 2)
