@@ -157,7 +157,7 @@ def _split_gain(GL, HL, GR, HR, Gt, Ht, reg_lambda, gamma):
     ) - gamma
 
 
-@partial(jax.jit, static_argnames=("n_trees_cap", "depth_cap", "n_bins"))
+@partial(jax.jit, static_argnames=("n_trees_cap", "depth_cap", "n_bins", "axis_name"))
 def fit_binned(
     bins: jax.Array,  # (N, F) uint8/int32
     y: jax.Array,  # (N,) {0,1}
@@ -169,9 +169,17 @@ def fit_binned(
     n_trees_cap: int,
     depth_cap: int,
     n_bins: int,
+    axis_name: str | None = None,
 ) -> Forest:
     """Train a forest on pre-binned features. One XLA program: scan over
-    trees, unrolled level loop, one histogram pass per level."""
+    trees, unrolled level loop, one histogram pass per level.
+
+    With ``axis_name`` set (inside `shard_map` over a row-sharded mesh axis),
+    each device builds partial histograms / leaf sums of its row shard and a
+    `psum` over ICI reduces them — the GBDT analog of data-parallel gradient
+    all-reduce (SURVEY §5.7/§5.8). Split decisions are then identical on every
+    device and the returned forest is replicated.
+    """
     N, F = bins.shape
     n_internal = 2**depth_cap - 1
     n_leaves = 2**depth_cap
@@ -184,10 +192,17 @@ def fit_binned(
     def build_tree(margin, tree_idx):
         key = jax.random.fold_in(rng, tree_idx)
         k_row, k_col = jax.random.split(key)
+        if axis_name is not None:
+            # Decorrelate row subsampling across shards; k_col must stay
+            # identical everywhere so the column mask is globally consistent.
+            k_row = jax.random.fold_in(k_row, jax.lax.axis_index(axis_name))
 
         # Row subsampling (xgboost `subsample`) as a Bernoulli weight mask.
         sub = (jax.random.uniform(k_row, (N,)) < hp.subsample).astype(jnp.float32)
         w = base_w * sub
+        # Cover counts only rows that actively train this tree — fold-masked
+        # (CV), dp-padding and subsampled-out rows are all weight-0.
+        w_pos = (w > 0).astype(jnp.float32)
         p = jax.nn.sigmoid(margin)
         g = w * (p - y)
         h = w * jnp.maximum(p * (1.0 - p), 1e-16)
@@ -217,11 +232,11 @@ def fit_binned(
             hist = gradient_histogram(
                 bins, local, g, h, n_nodes=n_nodes, n_bins=n_bins
             )  # (n_nodes, F, B, 2)
-            covers = covers.at[offset : offset + n_nodes].set(
-                jax.ops.segment_sum(
-                    jnp.ones((N,), jnp.float32), local, num_segments=n_nodes
-                )
-            )
+            level_cover = jax.ops.segment_sum(w_pos, local, num_segments=n_nodes)
+            if axis_name is not None:
+                hist = jax.lax.psum(hist, axis_name)
+                level_cover = jax.lax.psum(level_cover, axis_name)
+            covers = covers.at[offset : offset + n_nodes].set(level_cover)
             miss = hist[:, :, 0, :]  # (n_nodes, F, 2) missing-bucket sums
             cum = jnp.cumsum(hist[:, :, 1:, :], axis=2)  # (n_nodes, F, B-1, 2)
             tot = cum[:, :, -1, :] + miss  # node totals, replicated over F
@@ -269,10 +284,12 @@ def fit_binned(
 
         leaf_local = node - (2**depth_cap - 1)
         sums = jax.ops.segment_sum(
-            jnp.stack([g, h, jnp.ones_like(g)], axis=-1),
+            jnp.stack([g, h, w_pos], axis=-1),
             leaf_local,
             num_segments=n_leaves,
         )
+        if axis_name is not None:
+            sums = jax.lax.psum(sums, axis_name)
         covers = covers.at[n_internal:].set(sums[:, 2])
         tree_on = (tree_idx < hp.n_estimators).astype(jnp.float32)
         leaf_val = -sums[:, 0] / (sums[:, 1] + hp.reg_lambda) * hp.learning_rate

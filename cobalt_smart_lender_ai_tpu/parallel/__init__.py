@@ -1,0 +1,28 @@
+"""Mesh-parallel execution: dp row sharding, CV x HPO fan-out, RFE."""
+
+from cobalt_smart_lender_ai_tpu.parallel.mesh import make_mesh, pad_rows
+from cobalt_smart_lender_ai_tpu.parallel.rfe import RFEResult, rfe_select
+from cobalt_smart_lender_ai_tpu.parallel.sharded import fit_binned_dp, predict_margin_dp
+from cobalt_smart_lender_ai_tpu.parallel.tune import (
+    SearchResult,
+    cross_validate_gbdt,
+    randomized_search,
+    sample_candidates,
+    stack_candidates,
+    stratified_kfold_masks,
+)
+
+__all__ = [
+    "make_mesh",
+    "pad_rows",
+    "fit_binned_dp",
+    "predict_margin_dp",
+    "rfe_select",
+    "RFEResult",
+    "randomized_search",
+    "cross_validate_gbdt",
+    "sample_candidates",
+    "stack_candidates",
+    "stratified_kfold_masks",
+    "SearchResult",
+]

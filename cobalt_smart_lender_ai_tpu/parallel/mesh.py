@@ -1,0 +1,46 @@
+"""Device-mesh construction.
+
+The framework's two parallel axes (SURVEY §2.2, §5.7):
+
+- ``dp`` — shards the *row* axis. GBDT histogram builds and NN batch grads are
+  computed per-device and psum-reduced over ICI (the analog of the reference's
+  within-XGBoost OpenMP threading).
+- ``hp`` — shards the *job* axis: CV-fold x hyperparameter-candidate jobs of
+  the tuning fan-out (the analog of the reference's joblib process pool at
+  `model_tree_train_test.py:155`).
+
+On a real pod slice both axes ride ICI; in tests an 8-device virtual CPU mesh
+stands in (`tests/conftest.py`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from cobalt_smart_lender_ai_tpu.config import MeshConfig
+
+
+def make_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a ``(hp, dp)`` mesh. ``dp=-1`` absorbs all remaining devices."""
+    cfg = config or MeshConfig()
+    devs = devices if devices is not None else jax.devices()
+    n = len(devs)
+    hp = max(1, cfg.hp)
+    if n % hp != 0:
+        raise ValueError(f"hp={hp} does not divide device count {n}")
+    dp = n // hp if cfg.dp == -1 else cfg.dp
+    if hp * dp != n:
+        raise ValueError(f"mesh {hp}x{dp} != {n} devices")
+    arr = np.asarray(devs).reshape(hp, dp)
+    return Mesh(arr, (cfg.axis_hp, cfg.axis_dp))
+
+
+def pad_rows(n: int, multiple: int) -> int:
+    """Rows to append so the row axis divides the dp mesh axis."""
+    return (-n) % multiple

@@ -1,0 +1,105 @@
+"""Recursive feature elimination to exactly ``n_select`` features.
+
+Capability match for `RFE(XGBClassifier(...), n_features_to_select=20,
+step=1).fit(...)` at `model_tree_train_test.py:111-121` — the reference's hot
+loop #1 (~123 sequential XGBoost fits). TPU-first difference (SURVEY hard part
+(c)): dropped features are *masked*, never materialized out of the matrix, so
+every refit reuses one compiled XLA program with static shapes — zero
+recompiles across the whole elimination schedule — and each refit's rows can
+shard over the ``dp`` mesh axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from cobalt_smart_lender_ai_tpu.config import GBDTConfig, RFEConfig
+from cobalt_smart_lender_ai_tpu.models.gbdt import (
+    GBDTHyperparams,
+    fit_binned,
+    gain_importances,
+)
+from cobalt_smart_lender_ai_tpu.ops.binning import compute_bin_edges, transform
+from cobalt_smart_lender_ai_tpu.parallel.sharded import fit_binned_dp
+
+
+@dataclasses.dataclass
+class RFEResult:
+    support_: np.ndarray  # (F,) bool — selected features
+    ranking_: np.ndarray  # (F,) int — 1 for selected, 2.. in drop order (last dropped = 2)
+    n_features_: int
+
+
+def rfe_select(
+    X,
+    y,
+    config: RFEConfig | None = None,
+    *,
+    mesh: Mesh | None = None,
+    dp_axis: str = "dp",
+) -> RFEResult:
+    """Eliminate to exactly ``config.n_select`` features by repeatedly
+    refitting a light selector GBDT and dropping the ``step``
+    lowest-total-gain surviving features."""
+    cfg = config or RFEConfig()
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y)
+    N, F = X.shape
+    n_bins = 64  # selector fidelity; final model re-bins at full resolution
+    spec = compute_bin_edges(X, n_bins=n_bins)
+    bins = transform(spec, X)
+    hp = GBDTHyperparams.from_config(
+        GBDTConfig(
+            n_estimators=cfg.n_estimators, max_depth=cfg.max_depth, n_bins=n_bins
+        )
+    )
+    rng = jax.random.PRNGKey(cfg.seed)
+    sw = jnp.ones((N,), jnp.float32)
+
+    mask = np.ones(F, dtype=bool)
+    ranking = np.ones(F, dtype=np.int64)
+    next_rank = F - cfg.n_select + 1  # first-dropped gets the worst rank
+    it = 0
+    while mask.sum() > cfg.n_select:
+        fm = jnp.asarray(mask)
+        if mesh is not None:
+            forest = fit_binned_dp(
+                mesh,
+                bins,
+                y,
+                sw,
+                fm,
+                hp,
+                jax.random.fold_in(rng, it),
+                n_trees_cap=cfg.n_estimators,
+                depth_cap=cfg.max_depth,
+                n_bins=n_bins,
+                dp_axis=dp_axis,
+            )
+        else:
+            forest = fit_binned(
+                bins,
+                y,
+                sw,
+                fm,
+                hp,
+                jax.random.fold_in(rng, it),
+                n_trees_cap=cfg.n_estimators,
+                depth_cap=cfg.max_depth,
+                n_bins=n_bins,
+            )
+        total_gain, _ = gain_importances(forest, F)
+        imp = np.array(total_gain)  # copy: np.asarray of a jax array is read-only
+        imp[~mask] = np.inf  # already-dropped features can't be re-dropped
+        k = int(min(cfg.step, mask.sum() - cfg.n_select))
+        drop = np.argsort(imp, kind="stable")[:k]
+        mask[drop] = False
+        ranking[drop] = next_rank
+        next_rank -= 1
+        it += 1
+    return RFEResult(support_=mask, ranking_=ranking, n_features_=int(mask.sum()))

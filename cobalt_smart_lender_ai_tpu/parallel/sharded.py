@@ -1,0 +1,111 @@
+"""Row-sharded (data-parallel) GBDT training via `shard_map` + psum over ICI.
+
+This is the scaling story for the full 2.3M-row table (BASELINE north star):
+the binned feature matrix is sharded over the ``dp`` mesh axis, each device
+builds the gradient histograms of its row shard, and one `psum` per tree level
+reduces them over ICI — after which every device takes identical split
+decisions and the forest comes back replicated. The reference's equivalent is
+OpenMP threads inside libxgboost on one CPU (SURVEY §2.2, §5.8).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cobalt_smart_lender_ai_tpu.models.gbdt import (
+    Forest,
+    GBDTHyperparams,
+    fit_binned,
+    predict_margin,
+)
+from cobalt_smart_lender_ai_tpu.parallel.mesh import pad_rows
+
+
+def _pad_to(a: jax.Array, n_total: int, fill) -> jax.Array:
+    pad = n_total - a.shape[0]
+    if pad == 0:
+        return a
+    widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, widths, constant_values=fill)
+
+
+def fit_binned_dp(
+    mesh: Mesh,
+    bins: jax.Array,  # (N, F)
+    y: jax.Array,  # (N,)
+    sample_weight: jax.Array | None,
+    feature_mask: jax.Array | None,
+    hp: GBDTHyperparams,
+    rng: jax.Array,
+    *,
+    n_trees_cap: int,
+    depth_cap: int,
+    n_bins: int,
+    dp_axis: str = "dp",
+) -> Forest:
+    """Data-parallel `fit_binned`: rows sharded over ``dp_axis``, histograms
+    psum-reduced, forest replicated. Rows are zero-weight padded so the row
+    count divides the dp axis size."""
+    N, F = bins.shape
+    sw = jnp.ones((N,), jnp.float32) if sample_weight is None else sample_weight
+    fm = jnp.ones((F,), bool) if feature_mask is None else feature_mask
+    dp = mesh.shape[dp_axis]
+    n_total = N + pad_rows(N, dp)
+    bins = _pad_to(bins, n_total, 0)  # bin 0 = missing; weight-0 anyway
+    y = _pad_to(y, n_total, 0)
+    sw = _pad_to(sw.astype(jnp.float32), n_total, 0.0)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(dp_axis, None), P(dp_axis), P(dp_axis), P(None), P(), P(None)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def _fit(bins_l, y_l, sw_l, fm_l, hp_l, rng_l):
+        return fit_binned(
+            bins_l,
+            y_l,
+            sw_l,
+            fm_l,
+            hp_l,
+            rng_l,
+            n_trees_cap=n_trees_cap,
+            depth_cap=depth_cap,
+            n_bins=n_bins,
+            axis_name=dp_axis,
+        )
+
+    return jax.jit(_fit)(bins, y, sw, fm, hp, rng)
+
+
+def predict_margin_dp(
+    mesh: Mesh,
+    forest: Forest,
+    X: jax.Array,
+    *,
+    use_binned: bool = False,
+    dp_axis: str = "dp",
+) -> jax.Array:
+    """Row-sharded predict: each device descends its row shard through the
+    replicated forest; the (N,) margin comes back row-sharded."""
+    N = X.shape[0]
+    dp = mesh.shape[dp_axis]
+    n_total = N + pad_rows(N, dp)
+    Xp = _pad_to(X, n_total, 0)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(dp_axis, None)),
+        out_specs=P(dp_axis),
+        check_vma=False,
+    )
+    def _pred(forest_l, X_l):
+        return predict_margin(forest_l, X_l, use_binned=use_binned)
+
+    return jax.jit(_pred)(forest, Xp)[:N]

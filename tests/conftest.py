@@ -8,12 +8,22 @@ run without pod hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment may pre-set JAX_PLATFORMS to a real
+# accelerator; tests must run on the 8-device virtual CPU backend regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# A sitecustomize may have imported jax at interpreter startup (before this
+# file), freezing jax_platforms from the outer env; override via config. The
+# XLA flag above is still read lazily at first backend init, so the CPU
+# backend comes up with 8 virtual devices.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
