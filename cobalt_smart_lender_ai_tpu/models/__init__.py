@@ -10,9 +10,18 @@ from cobalt_smart_lender_ai_tpu.models.gbdt import (
     gain_importances,
     predict_margin,
 )
+from cobalt_smart_lender_ai_tpu.models.ft_transformer import (
+    FTTransformer,
+    FTTransformerClassifier,
+)
 from cobalt_smart_lender_ai_tpu.models.linear import LogisticRegression
+from cobalt_smart_lender_ai_tpu.models.nn import MLP, MLPClassifier
 
 __all__ = [
+    "MLP",
+    "MLPClassifier",
+    "FTTransformer",
+    "FTTransformerClassifier",
     "Forest",
     "GBDTClassifier",
     "GBDTHyperparams",

@@ -1,0 +1,179 @@
+"""FT-Transformer on raw numeric + categorical columns (BASELINE configs[3]).
+
+The modern-tabular model family the reference lacks. Architecture follows the
+public FT-Transformer recipe (per-feature linear tokenizer + categorical
+embeddings + [CLS] token + pre-norm transformer blocks), implemented TPU-first:
+the token axis is the ~20-116 feature axis — far too short for sequence
+parallelism (an explicit non-goal, SURVEY §5.7) — so parallelism is pure data
+parallel over the batch via sharded jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cobalt_smart_lender_ai_tpu.config import FTTransformerConfig
+from cobalt_smart_lender_ai_tpu.data.split import split_mask
+from cobalt_smart_lender_ai_tpu.models.train_loop import TrainSettings, fit_binary
+
+
+class FTTransformer(nn.Module):
+    n_numeric: int
+    vocab_sizes: tuple[int, ...]  # one per categorical column
+    d_token: int = 64
+    n_blocks: int = 3
+    n_heads: int = 8
+    ffn_mult: int = 2
+    dropout: float = 0.1
+
+    @nn.compact
+    def __call__(self, x_num, x_cat, deterministic: bool = True):
+        B = x_num.shape[0]
+        d = self.d_token
+        init = nn.initializers.truncated_normal(0.02)
+        tokens = []
+        if self.n_numeric:
+            w = self.param("num_w", init, (self.n_numeric, d))
+            b = self.param("num_b", nn.initializers.zeros, (self.n_numeric, d))
+            tokens.append(x_num[..., None] * w[None] + b[None])  # (B, Fn, d)
+        for i, vocab in enumerate(self.vocab_sizes):
+            emb = nn.Embed(vocab, d, name=f"cat_emb_{i}")(x_cat[:, i])
+            tokens.append(emb[:, None, :])
+        cls = self.param("cls", init, (1, 1, d))
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, d))] + tokens, axis=1)
+        for _ in range(self.n_blocks):
+            h = nn.LayerNorm()(x)
+            h = nn.MultiHeadDotProductAttention(
+                num_heads=self.n_heads,
+                dropout_rate=self.dropout,
+                deterministic=deterministic,
+            )(h, h)
+            x = x + nn.Dropout(self.dropout, deterministic=deterministic)(h)
+            h = nn.LayerNorm()(x)
+            h = nn.Dense(d * self.ffn_mult)(h)
+            h = nn.gelu(h)
+            h = nn.Dense(d)(h)
+            x = x + nn.Dropout(self.dropout, deterministic=deterministic)(h)
+        return nn.Dense(1)(nn.LayerNorm()(x[:, 0]))[..., 0]
+
+
+@dataclasses.dataclass(frozen=True)
+class StandardStats:
+    mean: jax.Array
+    scale: jax.Array
+
+    @staticmethod
+    def fit(X: jax.Array) -> "StandardStats":
+        mean = jnp.nanmean(X, axis=0)
+        mean = jnp.where(jnp.isnan(mean), 0.0, mean)
+        Xf = jnp.where(jnp.isnan(X), mean[None, :], X)
+        return StandardStats(mean=mean, scale=jnp.maximum(jnp.std(Xf, axis=0), 1e-8))
+
+    def __call__(self, X: jax.Array) -> jax.Array:
+        Xs = (X - self.mean[None, :]) / self.scale[None, :]
+        return jnp.where(jnp.isnan(Xs), 0.0, Xs)
+
+
+jax.tree_util.register_dataclass(
+    StandardStats, data_fields=["mean", "scale"], meta_fields=[]
+)
+
+
+class FTTransformerClassifier:
+    """Facade over (x_num, x_cat) inputs. Categorical columns are integer
+    label codes (the NN feature path's encoding, `data/features.py`); codes
+    outside the vocabulary clamp to the last embedding row."""
+
+    def __init__(
+        self,
+        vocab_sizes: tuple[int, ...],
+        config: FTTransformerConfig | None = None,
+    ):
+        self.config = config or FTTransformerConfig()
+        self.vocab_sizes = tuple(int(v) for v in vocab_sizes)
+        self.module: FTTransformer | None = None
+        self.params = None
+        self.scaler: StandardStats | None = None
+        self.history: dict | None = None
+
+    def _prep(self, X_num, X_cat):
+        X_num = jnp.asarray(X_num, jnp.float32)
+        X_cat = jnp.asarray(X_cat, jnp.int32)
+        caps = jnp.asarray(self.vocab_sizes, jnp.int32)[None, :] - 1
+        return X_num, jnp.clip(X_cat, 0, caps)
+
+    def fit(self, X_num, X_cat, y, val=None) -> "FTTransformerClassifier":
+        cfg = self.config
+        X_num, X_cat = self._prep(X_num, X_cat)
+        y = jnp.asarray(y, jnp.float32)
+        if val is None:
+            va = np.asarray(split_mask(int(X_num.shape[0]), 0.1, cfg.seed))
+            val = ((X_num[va], X_cat[va]), y[va])
+            X_num, X_cat, y = X_num[~va], X_cat[~va], y[~va]
+        (Xv_num, Xv_cat), y_val = val
+        Xv_num, Xv_cat = self._prep(Xv_num, Xv_cat)
+
+        self.scaler = StandardStats.fit(X_num)
+        self.module = FTTransformer(
+            n_numeric=int(X_num.shape[1]),
+            vocab_sizes=self.vocab_sizes,
+            d_token=cfg.d_token,
+            n_blocks=cfg.n_blocks,
+            n_heads=cfg.n_heads,
+            ffn_mult=cfg.ffn_mult,
+            dropout=cfg.dropout,
+        )
+        n_pos = float(jnp.sum(y))
+        pos_weight = (float(y.shape[0]) - n_pos) / max(n_pos, 1.0)
+        self.params = self.module.init(
+            jax.random.PRNGKey(cfg.seed),
+            jnp.zeros((1, X_num.shape[1]), jnp.float32),
+            jnp.zeros((1, len(self.vocab_sizes)), jnp.int32),
+        )
+
+        def apply_fn(p, batch, rngs):
+            xn, xc = batch
+            return self.module.apply(
+                p, xn, xc, deterministic=rngs is None, rngs=rngs
+            )
+
+        settings = TrainSettings(
+            batch_size=cfg.batch_size,
+            epochs=cfg.epochs,
+            learning_rate=cfg.learning_rate,
+            weight_decay=cfg.weight_decay,
+            pos_weight=pos_weight,
+            seed=cfg.seed,
+        )
+        self.params, self.history = fit_binary(
+            apply_fn,
+            self.params,
+            (self.scaler(X_num), X_cat),
+            y,
+            settings,
+            X_val=(self.scaler(Xv_num), Xv_cat),
+            y_val=y_val,
+            uses_dropout=True,
+        )
+        return self
+
+    def predict_logits(self, X_num, X_cat) -> jax.Array:
+        assert self.params is not None and self.scaler is not None, "fit first"
+        X_num, X_cat = self._prep(X_num, X_cat)
+        return self.module.apply(
+            self.params, self.scaler(X_num), X_cat, deterministic=True
+        )
+
+    def predict_proba(self, X_num, X_cat) -> jax.Array:
+        p1 = jax.nn.sigmoid(self.predict_logits(X_num, X_cat))
+        return jnp.stack([1.0 - p1, p1], axis=1)
+
+    def predict(self, X_num, X_cat, threshold: float = 0.5) -> np.ndarray:
+        return np.asarray(
+            self.predict_proba(X_num, X_cat)[:, 1] >= threshold, dtype=np.int32
+        )

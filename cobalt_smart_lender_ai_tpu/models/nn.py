@@ -1,0 +1,125 @@
+"""Flax MLP challenger — the capability match for the Keras Sequential
+128/32/16/1 network of `notebooks/04_model_training.ipynb` cell 39 (AdamW,
+exponential LR decay, L2 regularization, early stopping), with class-weighted
+loss replacing SMOTE and min-max scaling fused into the jitted forward."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cobalt_smart_lender_ai_tpu.config import MLPConfig
+from cobalt_smart_lender_ai_tpu.data.split import train_test_split_hashed
+from cobalt_smart_lender_ai_tpu.models.train_loop import TrainSettings, fit_binary
+
+
+class MLP(nn.Module):
+    """relu MLP emitting logits; hidden sizes default (128, 32, 16)."""
+
+    hidden: tuple[int, ...] = (128, 32, 16)
+
+    @nn.compact
+    def __call__(self, x):
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(1)(x)[..., 0]
+
+
+@dataclasses.dataclass(frozen=True)
+class MinMaxStats:
+    """Device-side MinMaxScaler (the reference scales with sklearn's
+    MinMaxScaler in `04_model_training.ipynb` cell 32); NaNs impute to the
+    column minimum (scaled 0)."""
+
+    low: jax.Array  # (F,)
+    range_: jax.Array  # (F,)
+
+    @staticmethod
+    def fit(X: jax.Array) -> "MinMaxStats":
+        low = jnp.nanmin(X, axis=0)
+        high = jnp.nanmax(X, axis=0)
+        low = jnp.where(jnp.isnan(low), 0.0, low)
+        high = jnp.where(jnp.isnan(high), 1.0, high)
+        return MinMaxStats(low=low, range_=jnp.maximum(high - low, 1e-12))
+
+    def __call__(self, X: jax.Array) -> jax.Array:
+        Xs = (X - self.low[None, :]) / self.range_[None, :]
+        return jnp.clip(jnp.where(jnp.isnan(Xs), 0.0, Xs), -1.0, 2.0)
+
+
+jax.tree_util.register_dataclass(
+    MinMaxStats, data_fields=["low", "range_"], meta_fields=[]
+)
+
+
+class MLPClassifier:
+    """Keras-`fit`-shaped facade: scaling, class weighting, early stopping on
+    validation ROC-AUC (fixing the reference's dead `val_precision` monitor)."""
+
+    def __init__(self, config: MLPConfig | None = None):
+        self.config = config or MLPConfig()
+        self.module = MLP(hidden=tuple(self.config.hidden_sizes))
+        self.params = None
+        self.scaler: MinMaxStats | None = None
+        self.history: dict | None = None
+
+    def fit(self, X, y, X_val=None, y_val=None) -> "MLPClassifier":
+        cfg = self.config
+        X = jnp.asarray(X, jnp.float32)
+        y = jnp.asarray(y, jnp.float32)
+        if X_val is None:
+            # hashed 10% holdout for the early-stop monitor
+            X, X_val, y, y_val = train_test_split_hashed(
+                X, y, test_fraction=0.1, seed=cfg.seed
+            )
+        else:
+            X_val = jnp.asarray(X_val, jnp.float32)
+            y_val = jnp.asarray(y_val, jnp.float32)
+        self.scaler = MinMaxStats.fit(X)
+        Xs, Xvs = self.scaler(X), self.scaler(X_val)
+
+        pos_weight = cfg.positive_class_weight
+        if pos_weight is None:  # balanced, like scale_pos_weight
+            n_pos = float(jnp.sum(y))
+            pos_weight = (float(y.shape[0]) - n_pos) / max(n_pos, 1.0)
+
+        self.params = self.module.init(
+            jax.random.PRNGKey(cfg.seed), jnp.zeros((1, Xs.shape[1]), jnp.float32)
+        )
+        settings = TrainSettings(
+            batch_size=cfg.batch_size,
+            epochs=cfg.epochs,
+            learning_rate=cfg.learning_rate,
+            lr_decay_rate=cfg.lr_decay_rate,
+            lr_decay_steps=cfg.lr_decay_steps,
+            weight_decay=cfg.weight_decay,
+            l2=cfg.l2,
+            pos_weight=pos_weight,
+            early_stop_patience=cfg.early_stop_patience,
+            seed=cfg.seed,
+        )
+        self.params, self.history = fit_binary(
+            lambda p, xb, rngs: self.module.apply(p, xb),
+            self.params,
+            Xs,
+            y,
+            settings,
+            X_val=Xvs,
+            y_val=y_val,
+        )
+        return self
+
+    def predict_logits(self, X) -> jax.Array:
+        assert self.params is not None and self.scaler is not None, "fit first"
+        return self.module.apply(self.params, self.scaler(jnp.asarray(X, jnp.float32)))
+
+    def predict_proba(self, X) -> jax.Array:
+        p1 = jax.nn.sigmoid(self.predict_logits(X))
+        return jnp.stack([1.0 - p1, p1], axis=1)
+
+    def predict(self, X, threshold: float = 0.5) -> np.ndarray:
+        return np.asarray(self.predict_proba(X)[:, 1] >= threshold, dtype=np.int32)

@@ -1,0 +1,168 @@
+"""Shared jitted training loop for the neural model families (MLP,
+FT-Transformer).
+
+Capability match for the Keras `model.fit` loop of
+`notebooks/04_model_training.ipynb` cell 39-40 (AdamW + ExponentialDecay +
+EarlyStopping), TPU-first:
+
+- the whole epoch is one `lax.scan` over pre-batched device arrays — no
+  per-step host dispatch;
+- class imbalance is a `pos_weight` in the BCE loss (replacing SMOTE, which
+  the reference uses only in the notebook path — SURVEY §2.2);
+- early stopping monitors validation ROC-AUC via the on-device sort-based
+  metric, fixing the reference's latent bug where EarlyStopping watched a
+  misspelled `val_precision` metric name and never fired (SURVEY §3.5);
+- under `jit` with the batch axis sharded over the ``dp`` mesh axis, XLA's
+  SPMD partitioner turns the batched grads into psum-reduced data-parallel
+  training automatically (`__graft_entry__.dryrun_multichip` exercises this).
+
+Batches are zero-weight padded so shapes stay static; the weighted loss makes
+padding inert.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from cobalt_smart_lender_ai_tpu.ops.metrics import roc_auc
+
+Batch = Any  # pytree of arrays with a common leading row axis
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainSettings:
+    batch_size: int = 1024
+    epochs: int = 30
+    learning_rate: float = 1e-3
+    lr_decay_rate: float = 0.9
+    lr_decay_steps: int = 1000
+    weight_decay: float = 1e-4
+    l2: float = 0.0  # explicit L2 loss term (Keras kernel_regularizer analog)
+    pos_weight: float = 1.0
+    early_stop_patience: int = 5
+    early_stop_min_delta: float = 1e-4
+    seed: int = 0
+
+
+def _num_rows(X: Batch) -> int:
+    return jax.tree.leaves(X)[0].shape[0]
+
+
+def _l2_penalty(params) -> jax.Array:
+    leaves = [
+        jnp.sum(jnp.square(v))
+        for path, v in jax.tree_util.tree_leaves_with_path(params)
+        if any(getattr(p, "key", None) == "kernel" for p in path)
+    ]
+    return sum(leaves) if leaves else jnp.float32(0.0)
+
+
+def make_optimizer(s: TrainSettings) -> optax.GradientTransformation:
+    schedule = optax.exponential_decay(
+        init_value=s.learning_rate,
+        transition_steps=s.lr_decay_steps,
+        decay_rate=s.lr_decay_rate,
+    )
+    return optax.adamw(schedule, weight_decay=s.weight_decay)
+
+
+def fit_binary(
+    apply_fn: Callable[..., jax.Array],  # (params, X_batch, rngs) -> logits
+    params,
+    X: Batch,
+    y: jax.Array,
+    settings: TrainSettings,
+    *,
+    X_val: Batch | None = None,
+    y_val: jax.Array | None = None,
+    sample_weight: jax.Array | None = None,
+    uses_dropout: bool = False,
+):
+    """Train to convergence/early stop; returns (best_params, history).
+
+    ``apply_fn(params, X_batch, rngs=...)`` must return logits. When a
+    validation set is given, early stopping tracks its ROC-AUC and the best
+    epoch's params are restored (Keras `restore_best_weights` semantics).
+    """
+    s = settings
+    N = _num_rows(X)
+    w = (
+        jnp.ones((N,), jnp.float32)
+        if sample_weight is None
+        else jnp.asarray(sample_weight, jnp.float32)
+    )
+    y = jnp.asarray(y, jnp.float32)
+    w = w * jnp.where(y > 0.5, jnp.float32(s.pos_weight), 1.0)
+
+    bs = min(s.batch_size, N)
+    n_batches = -(-N // bs)
+    n_padded = n_batches * bs
+    pad = [(0, n_padded - N)]
+    Xp = jax.tree.map(
+        lambda a: jnp.pad(a, pad + [(0, 0)] * (a.ndim - 1)), X
+    )
+    yp = jnp.pad(y, pad)
+    wp = jnp.pad(w, pad)  # padded rows weight 0 → inert
+
+    optimizer = make_optimizer(s)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(p, xb, yb, wb, rng):
+        rngs = {"dropout": rng} if uses_dropout else None
+        logits = apply_fn(p, xb, rngs=rngs)
+        bce = optax.sigmoid_binary_cross_entropy(logits, yb)
+        return jnp.sum(wb * bce) / jnp.maximum(jnp.sum(wb), 1e-6) + s.l2 * _l2_penalty(p)
+
+    @jax.jit
+    def train_epoch(p, opt_state, rng):
+        perm_rng, scan_rng = jax.random.split(rng)
+        perm = jax.random.permutation(perm_rng, n_padded)
+        Xs = jax.tree.map(lambda a: a[perm].reshape((n_batches, bs) + a.shape[1:]), Xp)
+        ys = yp[perm].reshape(n_batches, bs)
+        ws = wp[perm].reshape(n_batches, bs)
+
+        def step(carry, batch):
+            p, o, r = carry
+            xb, yb, wb = batch
+            r, sub = jax.random.split(r)
+            loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb, wb, sub)
+            updates, o = optimizer.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+            return (p, o, r), loss
+
+        (p, opt_state, _), losses = jax.lax.scan(
+            step, (p, opt_state, scan_rng), (Xs, ys, ws)
+        )
+        return p, opt_state, losses.mean()
+
+    @jax.jit
+    def val_auc_fn(p):
+        logits = apply_fn(p, X_val, rngs=None)
+        return roc_auc(jnp.asarray(y_val, jnp.float32), logits)
+
+    rng = jax.random.PRNGKey(s.seed)
+    history = {"loss": [], "val_auc": []}
+    best_auc, best_params, wait = -np.inf, params, 0
+    for epoch in range(s.epochs):
+        rng, sub = jax.random.split(rng)
+        params, opt_state, loss = train_epoch(params, opt_state, sub)
+        history["loss"].append(float(loss))
+        if X_val is not None:
+            auc = float(val_auc_fn(params))
+            history["val_auc"].append(auc)
+            if auc > best_auc + s.early_stop_min_delta:
+                best_auc, best_params, wait = auc, params, 0
+            else:
+                wait += 1
+                if wait >= s.early_stop_patience:
+                    break
+        else:
+            best_params = params
+    return best_params, history
