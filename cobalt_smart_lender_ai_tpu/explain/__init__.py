@@ -1,0 +1,45 @@
+"""Model explanation: exact TreeSHAP and gain importances."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from cobalt_smart_lender_ai_tpu.explain.treeshap import shap_values
+from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier, gain_importances
+
+
+class TreeExplainer:
+    """shap.TreeExplainer-shaped facade over the jitted kernel, the drop-in
+    for the API's explainer (`cobalt_fast_api.py:46,100-101`)."""
+
+    def __init__(self, model: GBDTClassifier):
+        assert model.forest is not None, "fit the model first"
+        self.model = model
+        self._base: float | None = None
+
+    def shap_values(self, X, chunk_size: int = 256) -> np.ndarray:
+        X = jnp.asarray(X, jnp.float32)
+        n = X.shape[0]
+        out = []
+        for start in range(0, n, chunk_size):
+            phis, base = shap_values(
+                self.model.forest,
+                X[start : start + chunk_size],
+                n_features=self.model.n_features_,
+            )
+            self._base = float(base)
+            out.append(np.asarray(phis))
+        return np.concatenate(out, axis=0)
+
+    @property
+    def expected_value(self) -> float:
+        if self._base is None:
+            phis, base = shap_values(
+                self.model.forest,
+                jnp.zeros((1, self.model.n_features_), jnp.float32),
+                n_features=self.model.n_features_,
+            )
+            self._base = float(base)
+        return self._base
+
+
+__all__ = ["shap_values", "TreeExplainer", "gain_importances"]
