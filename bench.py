@@ -1,0 +1,94 @@
+"""Benchmark: full GBDT training throughput on one TPU chip.
+
+Trains the reference's tuned production configuration (300 trees, depth 3,
+lr 0.05 — BASELINE.md best hyperparams) on a 500k-row x 100-feature synthetic
+credit table, end-to-end on device (quantile binning + all boosting rounds),
+and reports rows/sec/chip.
+
+``vs_baseline`` compares against the only training throughput the reference
+ever recorded: the Keras MLP's ~26k rows/s on CPU (BASELINE.md, `04` cell 40)
+— the reference never timed its XGBoost path.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_ROWS_PER_SEC = 26_000.0  # reference CPU training throughput
+N_ROWS, N_FEATURES = 500_000, 100
+N_TREES, MAX_DEPTH, N_BINS = 300, 3, 64
+CHUNK_TREES = 100  # keep each dispatch well under the ~60s environment limit
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from cobalt_smart_lender_ai_tpu.config import GBDTConfig
+    from cobalt_smart_lender_ai_tpu.models.gbdt import (
+        GBDTHyperparams,
+        fit_binned_chunked,
+        predict_margin,
+    )
+    from cobalt_smart_lender_ai_tpu.ops.binning import compute_bin_edges, transform
+    from cobalt_smart_lender_ai_tpu.ops.metrics import roc_auc
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
+    logits = X[:, :10] @ rng.normal(size=10) * 0.7
+    y = (logits + rng.logistic(size=N_ROWS) > 0).astype(np.int32)
+    X[rng.random(X.shape) < 0.02] = np.nan  # exercise missing-value routing
+
+    hp = GBDTHyperparams.from_config(
+        GBDTConfig(
+            n_estimators=N_TREES, max_depth=MAX_DEPTH, learning_rate=0.05, n_bins=N_BINS
+        )
+    )
+    Xd = jnp.asarray(X)
+    yd = jnp.asarray(y)
+    sw = jnp.ones((N_ROWS,), jnp.float32)
+    fm = jnp.ones((N_FEATURES,), bool)
+
+    def run(key):
+        spec = compute_bin_edges(Xd, n_bins=N_BINS)
+        bins = transform(spec, Xd)
+        forest = fit_binned_chunked(
+            bins,
+            yd,
+            sw,
+            fm,
+            hp,
+            key,
+            n_trees_cap=N_TREES,
+            depth_cap=MAX_DEPTH,
+            n_bins=N_BINS,
+            chunk_trees=CHUNK_TREES,
+        )
+        # Fetch to force full execution (async dispatch otherwise lies).
+        np.asarray(forest.leaf_value)
+        return forest, bins
+
+    run(jax.random.PRNGKey(0))  # compile warmup
+    t0 = time.time()
+    forest, bins = run(jax.random.PRNGKey(1))
+    elapsed = time.time() - t0
+    auc = float(roc_auc(yd.astype(jnp.float32), predict_margin(forest, bins, use_binned=True)))
+
+    rows_per_sec = N_ROWS / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "gbdt_full_train_rows_per_sec_per_chip",
+                "value": round(rows_per_sec, 1),
+                "unit": f"rows/s (300 trees d3 {N_FEATURES}f, bin+fit, train AUC {auc:.3f})",
+                "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -157,8 +157,11 @@ def _split_gain(GL, HL, GR, HR, Gt, Ht, reg_lambda, gamma):
     ) - gamma
 
 
-@partial(jax.jit, static_argnames=("n_trees_cap", "depth_cap", "n_bins", "axis_name"))
-def fit_binned(
+@partial(
+    jax.jit,
+    static_argnames=("n_trees_cap", "depth_cap", "n_bins", "axis_name"),
+)
+def fit_binned_resumable(
     bins: jax.Array,  # (N, F) uint8/int32
     y: jax.Array,  # (N,) {0,1}
     sample_weight: jax.Array,  # (N,) float32 — CV fold masks ride here
@@ -170,15 +173,21 @@ def fit_binned(
     depth_cap: int,
     n_bins: int,
     axis_name: str | None = None,
-) -> Forest:
-    """Train a forest on pre-binned features. One XLA program: scan over
-    trees, unrolled level loop, one histogram pass per level.
+    init_margin: jax.Array | None = None,
+    tree_offset: jax.Array | int = 0,
+) -> tuple[Forest, jax.Array]:
+    """Train ``n_trees_cap`` boosting rounds starting from ``init_margin``,
+    returning (forest chunk, final margin) so a long run can be split across
+    dispatches (`fit_binned_chunked`) — this environment kills any single
+    dispatch running over ~60s. Tree indices are globally offset by
+    ``tree_offset`` for RNG streams and the `n_estimators` mask.
 
-    With ``axis_name`` set (inside `shard_map` over a row-sharded mesh axis),
-    each device builds partial histograms / leaf sums of its row shard and a
-    `psum` over ICI reduces them — the GBDT analog of data-parallel gradient
-    all-reduce (SURVEY §5.7/§5.8). Split decisions are then identical on every
-    device and the returned forest is replicated.
+    One XLA program: scan over trees, unrolled level loop, one histogram pass
+    per level. With ``axis_name`` set (inside `shard_map` over a row-sharded
+    mesh axis), each device builds partial histograms / leaf sums of its row
+    shard and a `psum` over ICI reduces them — the GBDT analog of
+    data-parallel gradient all-reduce (SURVEY §5.7/§5.8). Split decisions are
+    then identical on every device and the returned forest is replicated.
     """
     N, F = bins.shape
     n_internal = 2**depth_cap - 1
@@ -190,6 +199,7 @@ def fit_binned(
     row_ids = jnp.arange(N, dtype=jnp.int32)
 
     def build_tree(margin, tree_idx):
+        tree_idx = tree_idx + tree_offset
         key = jax.random.fold_in(rng, tree_idx)
         k_row, k_col = jax.random.split(key)
         if axis_name is not None:
@@ -283,10 +293,14 @@ def fit_binned(
             node = 2 * node + 1 + (1 - go_left.astype(jnp.int32))
 
         leaf_local = node - (2**depth_cap - 1)
-        sums = jax.ops.segment_sum(
-            jnp.stack([g, h, w_pos], axis=-1),
-            leaf_local,
-            num_segments=n_leaves,
+        # Per-channel 1-D segment-sums (a (N, 3) data array would tile to lane
+        # width 128 on TPU).
+        sums = jnp.stack(
+            [
+                jax.ops.segment_sum(v, leaf_local, num_segments=n_leaves)
+                for v in (g, h, w_pos)
+            ],
+            axis=-1,
         )
         if axis_name is not None:
             sums = jax.lax.psum(sums, axis_name)
@@ -298,12 +312,17 @@ def fit_binned(
         margin = margin + leaf_val[leaf_local]
         return margin, (feats, thrs, mls, gains, covers, leaf_val)
 
-    _, (feats, thrs, mls, gains, covers, leaf_vals) = jax.lax.scan(
+    margin0 = (
+        jnp.zeros((N,), jnp.float32)
+        if init_margin is None
+        else init_margin.astype(jnp.float32)
+    )
+    margin, (feats, thrs, mls, gains, covers, leaf_vals) = jax.lax.scan(
         build_tree,
-        jnp.zeros((N,), jnp.float32),
+        margin0,
         jnp.arange(n_trees_cap, dtype=jnp.int32),
     )
-    return Forest(
+    forest = Forest(
         feature=feats,
         thr_bin=thrs,
         thr_float=jnp.zeros_like(thrs, jnp.float32),  # filled by attach_float_thresholds
@@ -311,6 +330,86 @@ def fit_binned(
         gain=gains,
         cover=covers,
         leaf_value=leaf_vals,
+        depth=depth_cap,
+    )
+    return forest, margin
+
+
+def fit_binned(
+    bins: jax.Array,
+    y: jax.Array,
+    sample_weight: jax.Array,
+    feature_mask: jax.Array,
+    hp: GBDTHyperparams,
+    rng: jax.Array,
+    *,
+    n_trees_cap: int,
+    depth_cap: int,
+    n_bins: int,
+    axis_name: str | None = None,
+) -> Forest:
+    """Single-dispatch fit (see `fit_binned_resumable` for the semantics)."""
+    forest, _ = fit_binned_resumable(
+        bins,
+        y,
+        sample_weight,
+        feature_mask,
+        hp,
+        rng,
+        n_trees_cap=n_trees_cap,
+        depth_cap=depth_cap,
+        n_bins=n_bins,
+        axis_name=axis_name,
+    )
+    return forest
+
+
+def fit_binned_chunked(
+    bins: jax.Array,
+    y: jax.Array,
+    sample_weight: jax.Array,
+    feature_mask: jax.Array,
+    hp: GBDTHyperparams,
+    rng: jax.Array,
+    *,
+    n_trees_cap: int,
+    depth_cap: int,
+    n_bins: int,
+    chunk_trees: int,
+) -> Forest:
+    """Host-loop fit in chunks of ``chunk_trees`` boosting rounds per XLA
+    dispatch, carrying the margin between dispatches. Numerically identical
+    to `fit_binned` (same per-tree RNG streams via the global tree index);
+    needed because this environment kills dispatches running over ~60s."""
+    N = bins.shape[0]
+    margin = jnp.zeros((N,), jnp.float32)
+    chunks = []
+    for off in range(0, n_trees_cap, chunk_trees):
+        k = min(chunk_trees, n_trees_cap - off)
+        forest_c, margin = fit_binned_resumable(
+            bins,
+            y,
+            sample_weight,
+            feature_mask,
+            hp,
+            rng,
+            n_trees_cap=k,
+            depth_cap=depth_cap,
+            n_bins=n_bins,
+            init_margin=margin,
+            tree_offset=jnp.int32(off),
+        )
+        chunks.append(forest_c)
+    if len(chunks) == 1:
+        return chunks[0]
+    return Forest(
+        feature=jnp.concatenate([c.feature for c in chunks]),
+        thr_bin=jnp.concatenate([c.thr_bin for c in chunks]),
+        thr_float=jnp.concatenate([c.thr_float for c in chunks]),
+        missing_left=jnp.concatenate([c.missing_left for c in chunks]),
+        gain=jnp.concatenate([c.gain for c in chunks]),
+        cover=jnp.concatenate([c.cover for c in chunks]),
+        leaf_value=jnp.concatenate([c.leaf_value for c in chunks]),
         depth=depth_cap,
     )
 
