@@ -40,6 +40,7 @@ def synthetic_lendingclub_frame(
     *,
     missing_junk_cols: int = 3,
     duplicate_fraction: float = 0.002,
+    signal_scale: float = 3.5,
 ) -> pd.DataFrame:
     """Build a raw-schema frame of ``n_rows`` loans (plus a few duplicates)."""
     rng = np.random.default_rng(seed)
@@ -84,9 +85,12 @@ def synthetic_lendingclub_frame(
     num_rev_accts = np.clip(rng.poisson(14, n), 1, 80).astype(float)
 
     # --- Planted default risk (nonlinear, with interactions) -----------------
-    z = (
-        -2.05
-        + 9.0 * (int_rate - 0.13)
+    # The deterministic score is scaled so the Bayes-optimal AUC on observable
+    # features lands in the reference's headline regime (~0.95, BASELINE.md);
+    # at the default signal_scale an sklearn HistGBT oracle measures ~0.96
+    # test AUC and ~21% positive rate on 20k rows.
+    z_core = (
+        9.0 * (int_rate - 0.13)
         + 0.035 * (dti - 18)
         + 0.9 * (revol_util - 0.45)
         + 0.55 * term_is_60
@@ -98,6 +102,14 @@ def synthetic_lendingclub_frame(
         + 0.30 * ((last_fico_high < 620).astype(float))
         - 0.08 * np.log1p(annual_inc / 1000)
         + 0.08 * np.log1p(loan_amnt / 1000)
+    )
+    # Center z_core (empirical mean ~0.65) so scaling it does not shift the
+    # logit mean. The base rate still drifts with signal_scale (E[sigmoid]
+    # depends on logit variance): ~20% — the LendingClub regime — holds at
+    # the default scale, not at arbitrary scales.
+    z = (
+        -4.1
+        + signal_scale * (z_core - 0.65)
         + rng.normal(0, 0.55, n)  # irreducible noise keeps AUC < 1
     )
     default = (rng.random(n) < _sigmoid(z)).astype(int)

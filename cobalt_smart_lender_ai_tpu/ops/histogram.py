@@ -53,8 +53,9 @@ def _hist_matmul(
     rhs = jnp.concatenate(
         [oh_node * g[:, None], oh_node * h[:, None]], axis=1
     )  # (N, 2K)
-    # Cap the block so the transient one-hot (R, F, B) f32 stays ~<=256MB.
-    R = min(row_block, N, max(512, (1 << 26) // max(F * n_bins // 4, 1)))
+    # Cap the block so the transient one-hot (R, F, B) stays <= 2^26 elements
+    # (256MB at f32) even if XLA fails to fuse it into the contraction.
+    R = min(row_block, N, max(512, (1 << 26) // max(F * n_bins, 1)))
     n_blocks = -(-N // R)
     pad = n_blocks * R - N
     if pad:

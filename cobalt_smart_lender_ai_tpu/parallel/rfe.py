@@ -31,7 +31,11 @@ from cobalt_smart_lender_ai_tpu.parallel.sharded import fit_binned_dp
 @dataclasses.dataclass
 class RFEResult:
     support_: np.ndarray  # (F,) bool — selected features
-    ranking_: np.ndarray  # (F,) int — 1 for selected, 2.. in drop order (last dropped = 2)
+    #: (F,) int — 1 for selected; eliminated features get one rank per
+    #: elimination iteration (features dropped together share it), last
+    #: iteration = 2, first iteration = n_iterations + 1 — sklearn RFE's
+    #: convention for any ``step``.
+    ranking_: np.ndarray
     n_features_: int
 
 
@@ -63,7 +67,8 @@ def rfe_select(
 
     mask = np.ones(F, dtype=bool)
     ranking = np.ones(F, dtype=np.int64)
-    next_rank = F - cfg.n_select + 1  # first-dropped gets the worst rank
+    n_iters = max(0, -(-(F - cfg.n_select) // cfg.step))
+    next_rank = n_iters + 1  # first iteration's drops get the worst rank
     it = 0
     while mask.sum() > cfg.n_select:
         fm = jnp.asarray(mask)

@@ -16,6 +16,7 @@ rows), keeping shapes static; validation AUC is the weighted sort-based
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 from typing import Any, Mapping, Sequence
 
@@ -41,18 +42,40 @@ def sample_candidates(
     space: Mapping[str, Sequence[Any]],
     n_iter: int,
     seed: int,
-    base: GBDTConfig,
 ) -> list[dict[str, Any]]:
     """Uniform random draws from a discrete grid — the sampling model of
     `RandomizedSearchCV` over the literal dict space
-    (`model_tree_train_test.py:139-146`)."""
+    (`model_tree_train_test.py:139-146`). Like sklearn's `ParameterSampler`
+    over a finite list grid, draws are without replacement whenever the grid
+    has at least ``n_iter`` distinct combinations, so small spaces never waste
+    fan-out slots on duplicates."""
     rng = np.random.default_rng(seed)
-    out = []
-    for _ in range(n_iter):
-        cand = {k: v[int(rng.integers(len(v)))] for k, v in space.items()}
-        out.append(cand)
-    del base
-    return out
+    keys = list(space.keys())
+    sizes = [len(space[k]) for k in keys]
+    total = math.prod(sizes) if sizes else 0
+    if 0 < total < 2**63 and n_iter <= total:
+        if n_iter > total // 2:
+            # Dense draw: a permutation is cheap when we take most of the grid
+            # (and the only O(total) branch, so total is small here).
+            flat = rng.permutation(total)[:n_iter]
+        else:
+            # n_iter << total: rejection-sample distinct codes in O(n_iter).
+            seen: dict[int, None] = {}
+            while len(seen) < n_iter:
+                seen.setdefault(int(rng.integers(total)), None)
+            flat = np.fromiter(seen, dtype=np.int64)
+        out = []
+        for code in flat:
+            cand = {}
+            for k, sz in zip(keys, sizes):
+                cand[k] = space[k][int(code % sz)]
+                code //= sz
+            out.append(cand)
+        return out
+    return [
+        {k: v[int(rng.integers(len(v)))] for k, v in space.items()}
+        for _ in range(n_iter)
+    ]
 
 
 def stack_candidates(
@@ -105,6 +128,7 @@ def cross_validate_gbdt(
     depth_cap: int,
     n_bins: int,
     feature_mask: jax.Array | None = None,
+    sample_weight: jax.Array | None = None,
     hp_axis: str = "hp",
     dp_axis: str = "dp",
 ) -> jax.Array:
@@ -112,11 +136,17 @@ def cross_validate_gbdt(
 
     Jobs shard over the ``hp`` mesh axis (padded to a multiple of its size);
     rows shard over ``dp``. One compiled program covers every job.
+    ``sample_weight`` scales both training weights and validation AUC weights.
     """
     C = jax.tree.leaves(hps)[0].shape[0]
     K, N = val_masks.shape
     F = bins.shape[1]
     fm = jnp.ones((F,), bool) if feature_mask is None else feature_mask
+    sw = (
+        jnp.ones((N,), jnp.float32)
+        if sample_weight is None
+        else sample_weight.astype(jnp.float32)
+    )
 
     # Flat job axis: candidate-major, fold-minor.
     job_hp = jax.tree.map(lambda a: jnp.repeat(a, K, axis=0), hps)
@@ -128,13 +158,17 @@ def cross_validate_gbdt(
     job_fold = _pad_to(job_fold, n_jobs_padded, 0)
     job_ids = jnp.arange(n_jobs_padded, dtype=jnp.int32)
 
-    # Row padding for the dp axis; padded rows are weight-0 and excluded from
-    # validation by a padded-out val mask.
+    # Row padding for the dp axis. Padding must be weight-0 on BOTH sides of
+    # the fold: excluded from validation by a padded-out val mask AND from
+    # training by the zero-padded row-weight vector (1 - val alone would train
+    # padded rows with weight 1). Row validity and the caller's sample_weight
+    # ride the same vector.
     dp_size = mesh.shape[dp_axis]
     n_total = N + pad_rows(N, dp_size)
     bins_p = _pad_to(bins, n_total, 0)
     y_p = _pad_to(y, n_total, 0)
     val_p = _pad_to(val_masks.astype(jnp.float32).T, n_total, 0.0).T  # (K, n_total)
+    w_p = _pad_to(sw, n_total, 0.0)
 
     @partial(
         jax.shard_map,
@@ -143,6 +177,7 @@ def cross_validate_gbdt(
             P(dp_axis, None),  # bins
             P(dp_axis),  # y
             P(None, dp_axis),  # val masks
+            P(dp_axis),  # row weights (0 on dp padding)
             P(hp_axis),  # job hp pytree
             P(hp_axis),  # job fold ids
             P(hp_axis),  # job global ids
@@ -152,9 +187,9 @@ def cross_validate_gbdt(
         out_specs=P(hp_axis, dp_axis),
         check_vma=False,
     )
-    def _run(bins_l, y_l, val_l, hp_l, fold_l, ids_l, fm_l, rng_l):
+    def _run(bins_l, y_l, val_l, w_l, hp_l, fold_l, ids_l, fm_l, rng_l):
         def one_job(hp_j, fold_j, id_j):
-            train_w = 1.0 - val_l[fold_j]
+            train_w = w_l * (1.0 - val_l[fold_j])
             forest = fit_binned(
                 bins_l,
                 y_l,
@@ -175,6 +210,7 @@ def cross_validate_gbdt(
         bins_p,
         y_p,
         val_p,
+        w_p,
         job_hp,
         job_fold,
         job_ids,
@@ -183,13 +219,13 @@ def cross_validate_gbdt(
     )  # (n_jobs_padded, n_total), sharded (hp, dp)
 
     @jax.jit
-    def _score(margins, val_masks_f, job_fold, y_f):
+    def _score(margins, val_masks_f, w_f, job_fold, y_f):
         def one(m, fold_j):
-            return roc_auc(y_f, m, weight=val_masks_f[fold_j])
+            return roc_auc(y_f, m, weight=val_masks_f[fold_j] * w_f)
 
         return jax.vmap(one)(margins, job_fold)
 
-    aucs = _score(margins, val_p, job_fold, y_p.astype(jnp.float32))
+    aucs = _score(margins, val_p, w_p, job_fold, y_p.astype(jnp.float32))
     return aucs[:n_jobs].reshape(C, K)
 
 
@@ -212,7 +248,7 @@ def randomized_search(
     spec = compute_bin_edges(X, n_bins=base.n_bins)
     bins = transform(spec, X)
 
-    candidates = sample_candidates(tune.param_space, tune.n_iter, tune.seed, base)
+    candidates = sample_candidates(tune.param_space, tune.n_iter, tune.seed)
     hps, n_trees_cap, depth_cap = stack_candidates(candidates, base)
     val_masks = jnp.asarray(stratified_kfold_masks(y_np, tune.cv_folds, tune.seed))
 
