@@ -1,20 +1,23 @@
 """cobalt_smart_lender_ai_tpu — a TPU-native tabular credit-risk ML framework.
 
-A from-scratch JAX/XLA/Pallas re-design of the capabilities of the reference
+A from-scratch JAX/XLA re-design of the capabilities of the reference
 application ``Kunvuthi/cobalt_smart_lender_ai`` (a pandas + XGBoost + Keras +
 FastAPI LendingClub loan-default pipeline):
 
 - ``data``     — columnar ingest, cleaning, feature engineering. String-heavy work
                  stays on host; all O(N) numeric transforms run jitted on device.
 - ``ops``      — metrics (sort-based ROC-AUC, classification report), quantile
-                 binning, gradient histograms (segment-sum + Pallas kernels).
+                 binning, gradient histograms (MXU-matmul formulation on TPU,
+                 segment-sum on CPU).
 - ``models``   — histogram GBDT (the XGBoost-equivalent), logistic regression,
                  Flax MLP, FT-Transformer.
 - ``parallel`` — device-mesh construction, CV x hyperparameter fan-out via
                  vmap/shard_map over ICI, RFE feature selection.
 - ``explain``  — exact TreeSHAP over tree tensors, gain importances.
-- ``serve``    — artifact store, prediction service with the reference's HTTP
-                 contract (FastAPI adapter + stdlib fallback).
+- ``io``       — object-store I/O (local/file:///s3://), DVC-style content
+                 pointers, self-describing model artifacts.
+- ``serve``    — prediction service with the reference's HTTP contract
+                 (stdlib server always; FastAPI adapter where installed).
 
 The reference runs everything on CPU through native code hidden in third-party
 dependencies (libxgboost, TensorFlow, shap's C++ TreeSHAP). Here every compute

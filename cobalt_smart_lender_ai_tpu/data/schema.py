@@ -137,6 +137,19 @@ SERVING_FIELD_ALIASES = {
     "hardship_status_No_Hardship": "hardship_status_No Hardship",
 }
 
+#: Serving fields typed `int` in the reference's pydantic schema — the one-hot
+#: indicator columns (cobalt_fast_api.py:72-79). Everything else is `float`.
+SERVING_INT_FEATURES = (
+    "grade_E",
+    "home_ownership_MORTGAGE",
+    "verification_status_Verified",
+    "application_type_Joint App",
+    "hardship_status_BROKEN",
+    "hardship_status_COMPLETE",
+    "hardship_status_COMPLETED",
+    "hardship_status_No Hardship",
+)
+
 # --- Categorical vocabularies (observed LendingClub values; used by the
 # --- synthetic generator and the label-encoding path) --------------------------
 

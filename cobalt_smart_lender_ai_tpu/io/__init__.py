@@ -1,0 +1,26 @@
+"""Object-store I/O and model-artifact persistence (reference capabilities
+C3 and C10): a uniform byte-blob store over local/file:///s3:// URIs, CSV
+frame round-trips, DVC-style content pointers, and self-describing model
+artifacts that let a trained model outlive its process."""
+
+from cobalt_smart_lender_ai_tpu.io.artifacts import (
+    FORMAT_VERSION,
+    GBDTArtifact,
+    MLPArtifact,
+    load_metrics,
+    plan_from_json,
+    plan_to_json,
+    save_metrics,
+)
+from cobalt_smart_lender_ai_tpu.io.store import ObjectStore
+
+__all__ = [
+    "FORMAT_VERSION",
+    "GBDTArtifact",
+    "MLPArtifact",
+    "ObjectStore",
+    "load_metrics",
+    "plan_from_json",
+    "plan_to_json",
+    "save_metrics",
+]

@@ -1,0 +1,252 @@
+"""Model artifact persistence — C10 / SURVEY §5.4.
+
+The reference persists its trained model as a joblib pickle plus a
+selected-feature text file uploaded to S3
+(`model_tree_train_test.py:215-230`) and restores both at serving startup
+(`cobalt_fast_api.py:42-47`). Pickles are process-fragile and
+code-version-coupled; here each artifact is a self-describing ``.npz``
+(pure arrays + a JSON header) so a trained model outlives its process,
+its host, and the exact library versions that trained it:
+
+- `GBDTArtifact` — tensorized `Forest`, `BinSpec` edges, feature order,
+  optional `FeaturePlan`, hyperparameter/config echo, metrics. Loading in a
+  fresh process reproduces bitwise-identical predictions (tested).
+- `MLPArtifact` — Flax params (via flax msgpack), `MinMaxStats` scaler,
+  feature order, config echo.
+
+A human-readable ``<key>.features.json`` sidecar mirrors the reference's
+`selected_features_tree.txt`, making the selected feature set an explicit
+versioned artifact (the SURVEY §2.1 "known inconsistency" asks for exactly
+this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io as _io
+import json
+from typing import Any, Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from cobalt_smart_lender_ai_tpu.data.features import FeaturePlan
+from cobalt_smart_lender_ai_tpu.io.store import ObjectStore
+from cobalt_smart_lender_ai_tpu.models.gbdt import Forest
+from cobalt_smart_lender_ai_tpu.ops.binning import BinSpec
+from cobalt_smart_lender_ai_tpu.version import __version__
+
+FORMAT_VERSION = 1
+
+
+# --- FeaturePlan <-> JSON -----------------------------------------------------
+
+
+def plan_to_json(plan: FeaturePlan) -> dict:
+    return {
+        "numeric_names": list(plan.numeric_names),
+        "categorical_vocab": {k: list(v) for k, v in plan.categorical_vocab.items()},
+        "label_vocab": {k: list(v) for k, v in plan.label_vocab.items()},
+        "medians": dict(plan.medians),
+        "log_cols": list(plan.log_cols),
+        "tree_feature_names": list(plan.tree_feature_names),
+        "nn_feature_names": list(plan.nn_feature_names),
+    }
+
+
+def plan_from_json(d: Mapping[str, Any]) -> FeaturePlan:
+    return FeaturePlan(
+        numeric_names=tuple(d["numeric_names"]),
+        categorical_vocab={k: tuple(v) for k, v in d["categorical_vocab"].items()},
+        label_vocab={k: tuple(v) for k, v in d["label_vocab"].items()},
+        medians={k: float(v) for k, v in d["medians"].items()},
+        log_cols=tuple(d["log_cols"]),
+        tree_feature_names=tuple(d["tree_feature_names"]),
+        nn_feature_names=tuple(d["nn_feature_names"]),
+    )
+
+
+# --- shared npz plumbing ------------------------------------------------------
+
+
+def _pack(arrays: Mapping[str, np.ndarray], header: dict) -> bytes:
+    buf = _io.BytesIO()
+    np.savez_compressed(
+        buf,
+        __header__=np.frombuffer(
+            json.dumps(header, sort_keys=True).encode(), dtype=np.uint8
+        ),
+        **arrays,
+    )
+    return buf.getvalue()
+
+def _unpack(data: bytes) -> tuple[dict[str, np.ndarray], dict]:
+    z = np.load(_io.BytesIO(data), allow_pickle=False)
+    header = json.loads(bytes(z["__header__"]).decode())
+    arrays = {k: z[k] for k in z.files if k != "__header__"}
+    return arrays, header
+
+
+def _check(header: dict, kind: str) -> None:
+    if header.get("kind") != kind:
+        raise ValueError(f"artifact kind {header.get('kind')!r}, expected {kind!r}")
+    if header.get("format_version", 0) > FORMAT_VERSION:
+        raise ValueError(
+            f"artifact format v{header['format_version']} is newer than this "
+            f"library understands (v{FORMAT_VERSION})"
+        )
+
+
+# --- GBDT ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GBDTArtifact:
+    """Everything serving needs to score and explain raw feature rows."""
+
+    forest: Forest
+    bin_spec: BinSpec
+    feature_names: tuple[str, ...]
+    plan: FeaturePlan | None = None
+    config: dict = dataclasses.field(default_factory=dict)
+    metrics: dict = dataclasses.field(default_factory=dict)
+
+    def to_bytes(self) -> bytes:
+        f = self.forest
+        header = {
+            "kind": "gbdt",
+            "format_version": FORMAT_VERSION,
+            "library_version": __version__,
+            "depth": f.depth,
+            "feature_names": list(self.feature_names),
+            "plan": None if self.plan is None else plan_to_json(self.plan),
+            "config": self.config,
+            "metrics": self.metrics,
+        }
+        arrays = {
+            "feature": np.asarray(f.feature),
+            "thr_bin": np.asarray(f.thr_bin),
+            "thr_float": np.asarray(f.thr_float),
+            "missing_left": np.asarray(f.missing_left),
+            "gain": np.asarray(f.gain),
+            "cover": np.asarray(f.cover),
+            "leaf_value": np.asarray(f.leaf_value),
+            "bin_edges": np.asarray(self.bin_spec.edges),
+        }
+        return _pack(arrays, header)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "GBDTArtifact":
+        arrays, header = _unpack(data)
+        _check(header, "gbdt")
+        forest = Forest(
+            feature=jnp.asarray(arrays["feature"]),
+            thr_bin=jnp.asarray(arrays["thr_bin"]),
+            thr_float=jnp.asarray(arrays["thr_float"]),
+            missing_left=jnp.asarray(arrays["missing_left"]),
+            gain=jnp.asarray(arrays["gain"]),
+            cover=jnp.asarray(arrays["cover"]),
+            leaf_value=jnp.asarray(arrays["leaf_value"]),
+            depth=int(header["depth"]),
+        )
+        return cls(
+            forest=forest,
+            bin_spec=BinSpec(edges=jnp.asarray(arrays["bin_edges"])),
+            feature_names=tuple(header["feature_names"]),
+            plan=None if header["plan"] is None else plan_from_json(header["plan"]),
+            config=header.get("config", {}),
+            metrics=header.get("metrics", {}),
+        )
+
+    def save(self, store: ObjectStore, key: str) -> None:
+        store.put_bytes(key + ".npz", self.to_bytes())
+        # Human-readable feature list, the reference's selected_features_tree.txt
+        # (model_tree_train_test.py:224-230).
+        store.put_json(key + ".features.json", list(self.feature_names))
+
+    @classmethod
+    def load(cls, store: ObjectStore, key: str) -> "GBDTArtifact":
+        return cls.from_bytes(store.get_bytes(key + ".npz"))
+
+
+# --- MLP ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MLPArtifact:
+    """Flax params + fused scaler — the `.keras` file + scaler pickle of the
+    reference's NN path (`04_model_training.ipynb` cell 44)."""
+
+    params: Any  # Flax params pytree
+    scaler_low: np.ndarray
+    scaler_range: np.ndarray
+    feature_names: tuple[str, ...]
+    hidden_sizes: tuple[int, ...]
+    config: dict = dataclasses.field(default_factory=dict)
+    metrics: dict = dataclasses.field(default_factory=dict)
+
+    def to_bytes(self) -> bytes:
+        from flax import serialization
+
+        header = {
+            "kind": "mlp",
+            "format_version": FORMAT_VERSION,
+            "library_version": __version__,
+            "feature_names": list(self.feature_names),
+            "hidden_sizes": list(self.hidden_sizes),
+            "config": self.config,
+            "metrics": self.metrics,
+        }
+        arrays = {
+            "params_msgpack": np.frombuffer(
+                serialization.msgpack_serialize(self.params), dtype=np.uint8
+            ),
+            "scaler_low": np.asarray(self.scaler_low),
+            "scaler_range": np.asarray(self.scaler_range),
+        }
+        return _pack(arrays, header)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "MLPArtifact":
+        from flax import serialization
+
+        arrays, header = _unpack(data)
+        _check(header, "mlp")
+        params = serialization.msgpack_restore(bytes(arrays["params_msgpack"]))
+        return cls(
+            params=params,
+            scaler_low=arrays["scaler_low"],
+            scaler_range=arrays["scaler_range"],
+            feature_names=tuple(header["feature_names"]),
+            hidden_sizes=tuple(header["hidden_sizes"]),
+            config=header.get("config", {}),
+            metrics=header.get("metrics", {}),
+        )
+
+    def save(self, store: ObjectStore, key: str) -> None:
+        store.put_bytes(key + ".npz", self.to_bytes())
+
+    @classmethod
+    def load(cls, store: ObjectStore, key: str) -> "MLPArtifact":
+        return cls.from_bytes(store.get_bytes(key + ".npz"))
+
+
+def save_metrics(store: ObjectStore, key: str, metrics: Mapping[str, Any]) -> None:
+    """metrics.json with the reference's schema — keys `auc`,
+    `classification_report`, `best_params` (model_tree_train_test.py:235-242)."""
+    store.put_json(key, dict(metrics))
+
+
+def load_metrics(store: ObjectStore, key: str) -> dict:
+    return store.get_json(key)
+
+
+__all__ = [
+    "FORMAT_VERSION",
+    "GBDTArtifact",
+    "MLPArtifact",
+    "plan_to_json",
+    "plan_from_json",
+    "save_metrics",
+    "load_metrics",
+]

@@ -1,0 +1,211 @@
+"""Object-store I/O — the C3 capability of the reference.
+
+The reference duplicates S3 CSV helpers across three scripts
+(`clean_data.py:44-84`, `feature_engineering.py:24-42`,
+`model_tree_train_test.py:37-71`), all hard-wired to boto3. Here one
+`ObjectStore` resolves a URI to a backend:
+
+- local path or ``file://`` — the offline default (this environment has no
+  object-store egress); keys become paths under the root.
+- ``s3://bucket[/prefix]`` — optional, only constructed if boto3 imports;
+  the same `put_bytes`/`get_bytes` contract over S3 objects.
+
+Every inter-stage artifact of the pipeline (cleaned CSVs, feature frames,
+model artifacts, metrics.json) moves through this layer, keyed by the
+`DataConfig`/`ServeConfig` keys, so stages compose across processes exactly
+like the reference's S3-glued scripts — without each stage re-implementing
+the transport.
+
+Content-addressed pointers (`write_pointer`/`verify_pointer`) reproduce the
+capability of the reference's DVC pointer files (`.dvc/config:1-4`,
+`data/1-raw/**/*.dvc`: md5 + size pinning of raw datasets).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io as _io
+import json
+import shutil
+from pathlib import Path
+from typing import Iterator
+
+import pandas as pd
+
+
+class ObjectStore:
+    """Uniform byte-blob store over a URI root.
+
+    >>> store = ObjectStore("artifacts")            # local directory
+    >>> store.put_bytes("a/b.txt", b"hi")
+    >>> store.get_bytes("a/b.txt")
+    b'hi'
+    """
+
+    def __new__(cls, uri: str):
+        if cls is ObjectStore:
+            if uri.startswith("s3://"):
+                return super().__new__(_S3Store)
+            return super().__new__(_LocalStore)
+        return super().__new__(cls)
+
+    def __init__(self, uri: str):
+        self.uri = uri
+
+    # -- byte-blob contract ---------------------------------------------------
+    def put_bytes(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get_bytes(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        raise NotImplementedError
+
+    # -- conveniences shared by every backend ---------------------------------
+    def put_file(self, key: str, path: str | Path) -> None:
+        self.put_bytes(key, Path(path).read_bytes())
+
+    def get_file(self, key: str, path: str | Path) -> Path:
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(self.get_bytes(key))
+        return p
+
+    def put_json(self, key: str, obj) -> None:
+        self.put_bytes(key, json.dumps(obj, indent=2, sort_keys=True).encode())
+
+    def get_json(self, key: str):
+        return json.loads(self.get_bytes(key).decode())
+
+    def save_frame(self, key: str, df: pd.DataFrame) -> None:
+        """CSV object write — `save_data_to_s3` (clean_data.py:70-84)."""
+        buf = _io.BytesIO()
+        df.to_csv(buf, index=False)
+        self.put_bytes(key, buf.getvalue())
+
+    def load_frame(self, key: str) -> pd.DataFrame:
+        """CSV object read — `load_data_from_s3` (clean_data.py:44-67)."""
+        return pd.read_csv(_io.BytesIO(self.get_bytes(key)), low_memory=False)
+
+    # -- content-addressed pointers (DVC-pointer capability, C2) --------------
+    def write_pointer(self, key: str) -> dict:
+        """Pin ``key``'s current content by md5+size in ``<key>.ptr.json`` —
+        the shape of the reference's `.dvc` pointer files."""
+        data = self.get_bytes(key)
+        ptr = {
+            "key": key,
+            "md5": hashlib.md5(data).hexdigest(),
+            "size": len(data),
+        }
+        self.put_json(key + ".ptr.json", ptr)
+        return ptr
+
+    def verify_pointer(self, key: str) -> bool:
+        """True iff ``key``'s content still matches its pinned pointer."""
+        ptr = self.get_json(key + ".ptr.json")
+        data = self.get_bytes(key)
+        return (
+            hashlib.md5(data).hexdigest() == ptr["md5"] and len(data) == ptr["size"]
+        )
+
+
+class _LocalStore(ObjectStore):
+    """Filesystem backend for plain paths and ``file://`` URIs."""
+
+    def __init__(self, uri: str):
+        super().__init__(uri)
+        root = uri[len("file://") :] if uri.startswith("file://") else uri
+        self.root = Path(root)
+
+    def _path(self, key: str) -> Path:
+        p = (self.root / key).resolve()
+        if not p.is_relative_to(self.root.resolve()):
+            raise ValueError(f"key {key!r} escapes store root {self.root}")
+        return p
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        p = self._path(key)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.with_suffix(p.suffix + ".tmp")
+        tmp.write_bytes(data)
+        tmp.replace(p)  # atomic within one filesystem
+
+    def get_bytes(self, key: str) -> bytes:
+        return self._path(key).read_bytes()
+
+    def exists(self, key: str) -> bool:
+        return self._path(key).is_file()
+
+    def delete(self, key: str) -> None:
+        p = self._path(key)
+        if p.is_dir():
+            shutil.rmtree(p)
+        elif p.exists():
+            p.unlink()
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        # String-prefix semantics, matching the S3 backend: 'models/gbdt/m'
+        # lists 'models/gbdt/m.npz' even though no such directory exists.
+        base = self.root.resolve()
+        if not base.exists():
+            return
+        for p in sorted(base.rglob("*")):
+            if p.is_file():
+                key = str(p.relative_to(base))
+                if key.startswith(prefix):
+                    yield key
+
+
+class _S3Store(ObjectStore):
+    """S3 backend (`s3://bucket[/prefix]`), capability match for the boto3
+    helpers at `clean_data.py:44-84`. Optional: requires boto3."""
+
+    def __init__(self, uri: str):
+        super().__init__(uri)
+        try:
+            import boto3
+        except ImportError as e:  # pragma: no cover - boto3 absent offline
+            raise ImportError(
+                "s3:// stores require boto3; use a local path or file:// URI"
+            ) from e
+
+        rest = uri[len("s3://") :]
+        bucket, _, prefix = rest.partition("/")
+        self.bucket = bucket
+        self.prefix = prefix.rstrip("/")
+        self.client = boto3.client("s3")
+
+    def _key(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def put_bytes(self, key: str, data: bytes) -> None:  # pragma: no cover
+        self.client.put_object(Bucket=self.bucket, Key=self._key(key), Body=data)
+
+    def get_bytes(self, key: str) -> bytes:  # pragma: no cover
+        resp = self.client.get_object(Bucket=self.bucket, Key=self._key(key))
+        return resp["Body"].read()
+
+    def exists(self, key: str) -> bool:  # pragma: no cover
+        try:
+            self.client.head_object(Bucket=self.bucket, Key=self._key(key))
+            return True
+        except self.client.exceptions.ClientError:
+            return False
+
+    def delete(self, key: str) -> None:  # pragma: no cover
+        self.client.delete_object(Bucket=self.bucket, Key=self._key(key))
+
+    def list(self, prefix: str = "") -> Iterator[str]:  # pragma: no cover
+        paginator = self.client.get_paginator("list_objects_v2")
+        full = self._key(prefix)
+        strip = len(self.prefix) + 1 if self.prefix else 0
+        for page in paginator.paginate(Bucket=self.bucket, Prefix=full):
+            for obj in page.get("Contents", []):
+                yield obj["Key"][strip:]

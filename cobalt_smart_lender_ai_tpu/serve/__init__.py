@@ -1,0 +1,27 @@
+"""Serving layer (L4) — the reference's FastAPI inference service
+(`cobalt_fast_api.py`) rebuilt around a TPU-resident pre-compiled scorer.
+
+- `service` — framework-agnostic `ScorerService`: artifact restore, the
+  20-field validation schema (with the two aliased names), and the three
+  endpoint handlers returning reference-shaped JSON.
+- `http_stdlib` — zero-dependency http.server adapter (this image has no
+  fastapi); serves the same routes/status codes.
+- `http_fastapi` — FastAPI adapter with the exact pydantic `SingleInput`
+  contract, for deployments that have fastapi installed.
+
+Entry point: ``python -m cobalt_smart_lender_ai_tpu.serve --store <uri>``.
+"""
+
+from cobalt_smart_lender_ai_tpu.serve.service import (
+    SINGLE_INPUT_FIELDS,
+    ScorerService,
+    ValidationError,
+    validate_single_input,
+)
+
+__all__ = [
+    "SINGLE_INPUT_FIELDS",
+    "ScorerService",
+    "ValidationError",
+    "validate_single_input",
+]

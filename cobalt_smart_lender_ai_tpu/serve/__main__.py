@@ -1,0 +1,46 @@
+"""CLI entry: restore the model artifact and serve the reference's HTTP
+contract — ``python -m cobalt_smart_lender_ai_tpu.serve --store artifacts``.
+
+Prefers the FastAPI adapter when fastapi+uvicorn are installed, otherwise
+falls back to the stdlib server; both expose identical routes.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from cobalt_smart_lender_ai_tpu.config import ServeConfig
+from cobalt_smart_lender_ai_tpu.io import ObjectStore
+from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--store", default="artifacts", help="object-store URI")
+    parser.add_argument("--model-key", default=ServeConfig.model_key)
+    parser.add_argument("--host", default=ServeConfig.host)
+    parser.add_argument("--port", type=int, default=ServeConfig.port)
+    args = parser.parse_args()
+
+    cfg = ServeConfig(host=args.host, port=args.port, model_key=args.model_key)
+    service = ScorerService.from_store(ObjectStore(args.store), cfg)
+    print(f"[INFO] model restored from {args.store}/{cfg.model_key}; "
+          f"{len(service.feature_names)} features")
+
+    try:
+        import uvicorn  # noqa: F401
+
+        from cobalt_smart_lender_ai_tpu.serve.http_fastapi import create_app
+
+        app = create_app(service=service)
+        print(f"[INFO] serving (fastapi) on {cfg.host}:{cfg.port}")
+        uvicorn.run(app, host=cfg.host, port=cfg.port)
+    except ImportError:
+        from cobalt_smart_lender_ai_tpu.serve.http_stdlib import serve_forever
+
+        print(f"[INFO] serving (stdlib) on {cfg.host}:{cfg.port}")
+        serve_forever(service, cfg.host, cfg.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+"""FastAPI adapter over `ScorerService` — route/schema parity with the
+reference's `cobalt_fast_api.py`, importable only where fastapi is installed
+(it is not in this offline image; the stdlib adapter covers that case).
+
+The pydantic schema reproduces `SingleInput` (cobalt_fast_api.py:59-82)
+including the two aliased field names with spaces and
+population-by-field-name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from cobalt_smart_lender_ai_tpu.config import ServeConfig
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.io import ObjectStore
+from cobalt_smart_lender_ai_tpu.serve.service import ScorerService, ValidationError
+
+
+def create_app(service: ScorerService | None = None, store_uri: str | None = None):
+    """Build the FastAPI app. Pass a ready `service` (tests) or a `store_uri`
+    to restore the model at startup like the reference's lifespan hook
+    (cobalt_fast_api.py:36-54)."""
+    from contextlib import asynccontextmanager
+
+    from fastapi import FastAPI, File, HTTPException, UploadFile
+    from pydantic import BaseModel, ConfigDict, Field
+
+    class SingleInput(BaseModel):
+        model_config = ConfigDict(populate_by_name=True)
+
+        loan_amnt: float
+        term: float
+        installment: float
+        fico_range_low: float
+        last_fico_range_high: float
+        open_il_12m: float
+        open_il_24m: float
+        max_bal_bc: float
+        num_rev_accts: float
+        pub_rec_bankruptcies: float
+        emp_length_num: float
+        earliest_cr_line_days: float
+        grade_E: int
+        home_ownership_MORTGAGE: int
+        verification_status_Verified: int
+        application_type_Joint_App: int = Field(
+            alias=schema.SERVING_FIELD_ALIASES["application_type_Joint_App"]
+        )
+        hardship_status_BROKEN: int
+        hardship_status_COMPLETE: int
+        hardship_status_COMPLETED: int
+        hardship_status_No_Hardship: int = Field(
+            alias=schema.SERVING_FIELD_ALIASES["hardship_status_No_Hardship"]
+        )
+
+    class BulkInput(BaseModel):
+        data: List[Dict[str, Any]]
+
+    state: dict[str, ScorerService] = {}
+    if service is not None:
+        state["service"] = service
+
+    @asynccontextmanager
+    async def lifespan(app):
+        if "service" not in state:
+            uri = store_uri or "artifacts"  # store ROOT; model_key is appended
+            state["service"] = ScorerService.from_store(ObjectStore(uri))
+        yield
+
+    app = FastAPI(title="Cobalt TPU Inference API", lifespan=lifespan)
+
+    @app.post("/predict")
+    def predict_single(input_data: SingleInput):
+        try:
+            return state["service"].predict_single(
+                input_data.model_dump(by_alias=True)
+            )
+        except ValidationError as e:
+            raise HTTPException(status_code=422, detail=str(e))
+
+    @app.post("/predict_bulk_csv")
+    async def predict_bulk_csv(file: UploadFile = File(...)):
+        try:
+            return state["service"].predict_bulk_csv(await file.read())
+        except ValidationError as e:
+            raise HTTPException(status_code=422, detail=str(e))
+        except Exception as e:
+            raise HTTPException(
+                status_code=500, detail=f"Bulk prediction failed: {e}"
+            )
+
+    @app.post("/feature_importance_bulk")
+    def feature_importance_bulk(data: BulkInput):
+        try:
+            return state["service"].feature_importance_bulk(data.model_dump())
+        except ValidationError as e:
+            raise HTTPException(status_code=400, detail=str(e))
+
+    return app

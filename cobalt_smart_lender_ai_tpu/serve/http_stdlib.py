@@ -1,0 +1,120 @@
+"""Zero-dependency HTTP adapter over `ScorerService` (stdlib http.server).
+
+This environment has no fastapi/uvicorn; the serving contract still has to be
+reachable over real HTTP (the reference serves on port 8000,
+`cobalt_fast_api.py:148-149`). Routes, methods, status codes and JSON bodies
+match the reference:
+
+- ``POST /predict``                — JSON body, 422 on schema violation
+- ``POST /predict_bulk_csv``      — multipart file upload or raw CSV body
+- ``POST /feature_importance_bulk`` — JSON ``{"data": [...]}``, 400 if empty
+
+Errors return ``{"detail": ...}`` like FastAPI's HTTPException. The handler
+is threaded (one TPU dispatch at a time is serialized by JAX itself, so a
+ThreadingHTTPServer is safe).
+"""
+
+from __future__ import annotations
+
+import email.parser
+import email.policy
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from cobalt_smart_lender_ai_tpu.serve.service import ScorerService, ValidationError
+
+
+def _extract_csv(body: bytes, content_type: str) -> bytes:
+    """Pull the uploaded file out of a multipart/form-data body (the
+    reference's `UploadFile`), or accept a raw CSV body (text/csv)."""
+    if content_type.startswith("multipart/form-data"):
+        msg = email.parser.BytesParser(policy=email.policy.default).parsebytes(
+            b"Content-Type: " + content_type.encode() + b"\r\n\r\n" + body
+        )
+        # Bind the part named "file" (the reference's `UploadFile = File(...)`)
+        # or any part carrying a filename; other form fields are not the CSV.
+        for part in msg.iter_parts():
+            if part.get_content_disposition() == "form-data" and (
+                part.get_param("name", header="content-disposition") == "file"
+                or part.get_filename() is not None
+            ):
+                return part.get_payload(decode=True)
+        raise ValidationError("multipart body contains no file part")
+    return body
+
+
+def make_handler(service: ScorerService):
+    class Handler(BaseHTTPRequestHandler):
+        # quieter default logging; the reference prints [INFO] lines instead
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _send(self, code: int, obj) -> None:
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _json_body(self, body: bytes):
+            try:
+                return json.loads(body.decode() or "{}")
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                raise ValidationError("body is not valid JSON")
+
+        def do_POST(self):  # noqa: N802 - http.server API
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            try:
+                if self.path == "/predict":
+                    self._send(200, service.predict_single(self._json_body(body)))
+                elif self.path == "/predict_bulk_csv":
+                    try:
+                        csv_bytes = _extract_csv(
+                            body, self.headers.get("Content-Type", "")
+                        )
+                        self._send(200, service.predict_bulk_csv(csv_bytes))
+                    except ValidationError:
+                        raise
+                    except Exception as e:
+                        # parity with the reference's try/except -> HTTP 500
+                        # on the bulk route (cobalt_fast_api.py:124-126)
+                        self._send(500, {"detail": f"Bulk prediction failed: {e}"})
+                elif self.path == "/feature_importance_bulk":
+                    payload = self._json_body(body)  # malformed JSON -> 422
+                    try:
+                        self._send(200, service.feature_importance_bulk(payload))
+                    except ValidationError as e:
+                        self._send(400, {"detail": str(e)})
+                else:
+                    self._send(404, {"detail": "Not Found"})
+            except ValidationError as e:
+                self._send(422, {"detail": str(e)})
+            except Exception as e:  # pragma: no cover
+                self._send(500, {"detail": f"Internal server error: {e}"})
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                self._send(200, {"status": "ok"})
+            else:
+                self._send(404, {"detail": "Not Found"})
+
+    return Handler
+
+
+def serve_forever(service: ScorerService, host: str = "0.0.0.0", port: int = 8000):
+    """Blocking server loop — `uvicorn.run` stand-in (cobalt_fast_api.py:148)."""
+    httpd = make_server(service, host, port)
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+
+
+def make_server(
+    service: ScorerService, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """Build (but don't run) the server; port 0 picks a free port — used by
+    the in-process smoke tests."""
+    return ThreadingHTTPServer((host, port), make_handler(service))

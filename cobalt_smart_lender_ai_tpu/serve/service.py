@@ -1,0 +1,191 @@
+"""Framework-agnostic scoring service — the L4 capability core.
+
+The reference's API (`cobalt_fast_api.py`) couples model restore, input
+validation, scoring, and SHAP directly into FastAPI route functions. Here the
+service is a plain object with three handler methods returning JSON-shaped
+dicts — byte-compatible with the reference's response schemas — and the HTTP
+adapters (`http_stdlib.py`, `http_fastapi.py`) are thin shells over it. That
+keeps the TPU-resident scorer testable without an HTTP stack and lets the
+same service run under FastAPI, the stdlib server, or a test harness.
+
+Scoring is a pre-compiled `jax.jit` program resident on the accelerator
+(SURVEY §3.3 north-star change): `predict_margin` over the restored tree
+tensors for probabilities, `explain.treeshap.shap_values` for per-row
+attributions. Startup restores the model from the object store exactly like
+the reference's lifespan hook restores its S3 pickle
+(`cobalt_fast_api.py:36-54`).
+"""
+
+from __future__ import annotations
+
+import io as _io
+import math
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from cobalt_smart_lender_ai_tpu.config import ServeConfig
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.explain.treeshap import shap_values
+from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+from cobalt_smart_lender_ai_tpu.models.gbdt import (
+    gain_importances,
+    predict_margin,
+)
+
+
+class ValidationError(ValueError):
+    """Input failed the serving schema; adapters map it to HTTP 422."""
+
+
+#: The serving request schema: every field of the reference's pydantic
+#: `SingleInput` (cobalt_fast_api.py:59-82). Keys are the Python-identifier
+#: field names; values are the canonical (aliased) feature names.
+SINGLE_INPUT_FIELDS: dict[str, str] = {
+    **{n: n for n in schema.SERVING_FEATURES if " " not in n},
+    **schema.SERVING_FIELD_ALIASES,
+}
+#: Fields typed `int` in the reference schema (one-hot indicators), declared
+#: explicitly in data/schema.py next to the feature list that owns the contract.
+_INT_FIELDS = frozenset(
+    field
+    for field, canonical in SINGLE_INPUT_FIELDS.items()
+    if canonical in schema.SERVING_INT_FEATURES
+)
+
+
+def validate_single_input(payload: Mapping[str, Any]) -> dict[str, float]:
+    """Validate one request body against the 20-field schema, accepting both
+    field names and aliases (`allow_population_by_field_name`,
+    cobalt_fast_api.py:81-82). Returns {canonical feature name: value}."""
+    if not isinstance(payload, Mapping):
+        raise ValidationError("body must be a JSON object")
+    alias_to_field = {v: k for k, v in SINGLE_INPUT_FIELDS.items()}
+    row: dict[str, float] = {}
+    seen = set()
+    for key, value in payload.items():
+        field = key if key in SINGLE_INPUT_FIELDS else alias_to_field.get(key)
+        if field is None:
+            continue  # pydantic ignores unknown keys by default
+        canonical = SINGLE_INPUT_FIELDS[field]
+        if field in seen:
+            raise ValidationError(f"duplicate field {key!r}")
+        seen.add(field)
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise ValidationError(f"field {key!r} must be a number")
+        if field in _INT_FIELDS and not float(value).is_integer():
+            raise ValidationError(f"field {key!r} must be an integer")
+        if isinstance(value, float) and not math.isfinite(value):
+            raise ValidationError(f"field {key!r} must be finite")
+        row[canonical] = float(value)
+    missing = [
+        SINGLE_INPUT_FIELDS[f] for f in SINGLE_INPUT_FIELDS if f not in seen
+    ]
+    if missing:
+        raise ValidationError(f"missing fields: {sorted(missing)}")
+    return row
+
+
+class ScorerService:
+    """Restored model + pre-compiled scorer behind the three endpoints of
+    `cobalt_fast_api.py:96-143`."""
+
+    def __init__(self, artifact: GBDTArtifact):
+        self.artifact = artifact
+        self.feature_names = list(artifact.feature_names)
+        self._n_features = len(self.feature_names)
+        forest = artifact.forest
+        # Pre-compile both device programs at startup (the reference builds
+        # its TreeExplainer in the lifespan hook for the same reason).
+        self._margin_fn = jax.jit(lambda X: predict_margin(forest, X)).lower(
+            jax.ShapeDtypeStruct((1, self._n_features), jnp.float32)
+        ).compile()
+        self._shap_fn = jax.jit(
+            lambda X: shap_values(forest, X, n_features=self._n_features)
+        ).lower(jax.ShapeDtypeStruct((1, self._n_features), jnp.float32)).compile()
+        # Batch scoring keeps a cached jit per distinct batch shape.
+        self._batch_margin = jax.jit(lambda X: predict_margin(forest, X))
+        total_gain, _ = gain_importances(forest, self._n_features)
+        self._gain = np.asarray(total_gain)
+
+    @classmethod
+    def from_store(
+        cls, store: ObjectStore, config: ServeConfig | None = None
+    ) -> "ScorerService":
+        """Startup restore — the lifespan S3 download + joblib.load of
+        `cobalt_fast_api.py:42-47`."""
+        cfg = config or ServeConfig()
+        return cls(GBDTArtifact.load(store, cfg.model_key))
+
+    # -- scoring helpers ------------------------------------------------------
+
+    def _row_array(self, row: Mapping[str, float]) -> np.ndarray:
+        x = np.full((1, self._n_features), np.nan, dtype=np.float32)
+        for i, name in enumerate(self.feature_names):
+            if name in row:
+                x[0, i] = row[name]
+        return x
+
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        """P(default) for an (N, F) float array — `predict_proba_df`
+        (cobalt_fast_api.py:90-91)."""
+        margin = self._batch_margin(jnp.asarray(X, jnp.float32))
+        return np.asarray(jax.nn.sigmoid(margin))
+
+    # -- endpoint handlers ----------------------------------------------------
+
+    def predict_single(self, payload: Mapping[str, Any]) -> dict:
+        """`POST /predict` (cobalt_fast_api.py:96-108): probability + per-row
+        SHAP in the exact response shape."""
+        row = validate_single_input(payload)
+        x = self._row_array(row)
+        margin = self._margin_fn(jnp.asarray(x))
+        phis, base = self._shap_fn(jnp.asarray(x))
+        return {
+            "prob_default": float(jax.nn.sigmoid(margin)[0]),
+            "shap_values": np.asarray(phis)[0].tolist(),
+            "base_value": float(base),
+            "features": list(self.feature_names),
+            # Echo of the validated request (the reference echoes its input
+            # df row). Keyed by the schema's canonical names, which equal the
+            # model features for the deployed 20-feature contract.
+            "input_row": dict(row),
+        }
+
+    def predict_bulk_csv(self, csv_bytes: bytes) -> dict:
+        """`POST /predict_bulk_csv` (cobalt_fast_api.py:113-126): CSV in,
+        records with an appended `prob_default` column out; non-finite values
+        serialized as the string "null" exactly like the reference's
+        `fillna("null")`."""
+        df = pd.read_csv(_io.BytesIO(csv_bytes))
+        missing = [n for n in self.feature_names if n not in df.columns]
+        if missing:
+            raise ValidationError(f"csv missing feature columns: {missing}")
+        X = df[self.feature_names].to_numpy(dtype=np.float32, na_value=np.nan)
+        df = df.copy()
+        df["prob_default"] = self.predict_proba(X)
+        df = df.replace([np.inf, -np.inf], np.nan)
+        records = df.to_dict(orient="records")
+        for rec in records:
+            for k, v in rec.items():
+                if isinstance(v, float) and math.isnan(v):
+                    rec[k] = "null"
+        return {"predictions": records}
+
+    def feature_importance_bulk(self, payload: Mapping[str, Any]) -> dict:
+        """`POST /feature_importance_bulk` (cobalt_fast_api.py:128-143):
+        top-10 gain importances. Like the reference, the scores are static
+        booster gains — the posted rows are only checked for presence."""
+        if not isinstance(payload, Mapping) or not payload.get("data"):
+            raise ValidationError("No data provided.")
+        order = np.argsort(-self._gain)[:10]
+        return {
+            "top_features": [
+                {"feature": self.feature_names[i], "importance": float(self._gain[i])}
+                for i in order
+                if self._gain[i] > 0
+            ]
+        }

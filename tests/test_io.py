@@ -1,0 +1,164 @@
+"""Object-store round-trips (C3) and artifact persistence (C10): a trained
+model saved, restored in a *fresh process*, and asserted bitwise-identical."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, MLPArtifact, ObjectStore
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return ObjectStore(str(tmp_path / "lake"))
+
+
+def test_bytes_json_roundtrip(store):
+    store.put_bytes("a/b/blob.bin", b"\x00\x01tpu")
+    assert store.get_bytes("a/b/blob.bin") == b"\x00\x01tpu"
+    assert store.exists("a/b/blob.bin") and not store.exists("a/b/nope")
+    store.put_json("meta.json", {"auc": 0.95, "params": {"depth": 3}})
+    assert store.get_json("meta.json")["params"]["depth"] == 3
+    store.delete("a/b/blob.bin")
+    assert not store.exists("a/b/blob.bin")
+
+
+def test_file_uri_and_listing(tmp_path):
+    store = ObjectStore(f"file://{tmp_path}/lake2")
+    store.put_bytes("x/1.bin", b"1")
+    store.put_bytes("x/2.bin", b"2")
+    store.put_bytes("y/3.bin", b"3")
+    assert list(store.list("x")) == ["x/1.bin", "x/2.bin"]
+    assert len(list(store.list())) == 3
+
+
+def test_key_escape_rejected(store):
+    with pytest.raises(ValueError):
+        store.put_bytes("../../escape", b"nope")
+
+
+def test_frame_roundtrip(store):
+    df = pd.DataFrame({"a": [1.5, np.nan, 3.0], "s": ["x", "y", "z"]})
+    store.save_frame("dataset/2-intermediate/cleaned_01.csv", df)
+    back = store.load_frame("dataset/2-intermediate/cleaned_01.csv")
+    pd.testing.assert_frame_equal(df, back)
+
+
+def test_content_pointer(store):
+    store.put_bytes("raw.csv", b"col\n1\n2\n")
+    ptr = store.write_pointer("raw.csv")
+    assert ptr["size"] == 8
+    assert store.verify_pointer("raw.csv")
+    store.put_bytes("raw.csv", b"col\n1\n3\n")  # content drifted
+    assert not store.verify_pointer("raw.csv")
+
+
+@pytest.fixture(scope="module")
+def trained_gbdt(train_test):
+    from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
+
+    X_train, X_test, y_train, _, names = train_test
+    model = GBDTClassifier(n_estimators=20, max_depth=3, n_bins=64)
+    model.fit(X_train[:2000], y_train[:2000])
+    return model, X_test[:256], names
+
+
+def test_gbdt_artifact_roundtrip_in_process(store, trained_gbdt):
+    model, X_test, names = trained_gbdt
+    art = GBDTArtifact(
+        forest=model.forest,
+        bin_spec=model.bin_spec,
+        feature_names=tuple(names),
+        config={"n_estimators": 20},
+        metrics={"auc": 0.9},
+    )
+    art.save(store, "models/gbdt/model_tree")
+    assert store.get_json("models/gbdt/model_tree.features.json") == list(names)
+    back = GBDTArtifact.load(store, "models/gbdt/model_tree")
+    assert back.feature_names == tuple(names)
+    assert back.config == {"n_estimators": 20}
+    m0 = np.asarray(model.predict_margin(X_test))
+    from cobalt_smart_lender_ai_tpu.models.gbdt import predict_margin
+
+    m1 = np.asarray(predict_margin(back.forest, X_test))
+    np.testing.assert_array_equal(m0, m1)  # bitwise
+
+
+def test_gbdt_artifact_fresh_process_bitwise(tmp_path, trained_gbdt):
+    """train -> save -> load in a NEW python process -> identical predictions
+    (the reference's S3-pickle restore contract, cobalt_fast_api.py:42-47)."""
+    model, X_test, names = trained_gbdt
+    store = ObjectStore(str(tmp_path / "lake"))
+    GBDTArtifact(
+        forest=model.forest, bin_spec=model.bin_spec, feature_names=tuple(names)
+    ).save(store, "m")
+    np.save(tmp_path / "X.npy", X_test)
+    np.save(tmp_path / "margin.npy", np.asarray(model.predict_margin(X_test)))
+    script = (
+        "import numpy as np\n"
+        "from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore\n"
+        "from cobalt_smart_lender_ai_tpu.models.gbdt import predict_margin\n"
+        f"store = ObjectStore({str(tmp_path / 'lake')!r})\n"
+        "art = GBDTArtifact.load(store, 'm')\n"
+        f"X = np.load({str(tmp_path / 'X.npy')!r})\n"
+        f"want = np.load({str(tmp_path / 'margin.npy')!r})\n"
+        "got = np.asarray(predict_margin(art.forest, X))\n"
+        "np.testing.assert_array_equal(got, want)\n"
+        "print('FRESH_PROCESS_OK')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=240,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "FRESH_PROCESS_OK" in out.stdout
+
+
+def test_mlp_artifact_roundtrip(store):
+    from cobalt_smart_lender_ai_tpu.models.nn import MLP, MinMaxStats
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 7)).astype(np.float32)
+    module = MLP(hidden=(8, 4))
+    params = module.init(jax.random.PRNGKey(0), X[:1])
+    scaler = MinMaxStats.fit(X)
+    art = MLPArtifact(
+        params=params,
+        scaler_low=np.asarray(scaler.low),
+        scaler_range=np.asarray(scaler.range_),
+        feature_names=tuple(f"f{i}" for i in range(7)),
+        hidden_sizes=(8, 4),
+    )
+    art.save(store, "models/nn/challenger")
+    back = MLPArtifact.load(store, "models/nn/challenger")
+    logits0 = np.asarray(module.apply(params, X))
+    logits1 = np.asarray(MLP(hidden=back.hidden_sizes).apply(back.params, X))
+    np.testing.assert_array_equal(logits0, logits1)
+    np.testing.assert_array_equal(np.asarray(scaler.low), back.scaler_low)
+
+
+def test_artifact_kind_mismatch(store, trained_gbdt):
+    model, _, names = trained_gbdt
+    GBDTArtifact(
+        forest=model.forest, bin_spec=model.bin_spec, feature_names=tuple(names)
+    ).save(store, "m2")
+    with pytest.raises(ValueError, match="kind"):
+        MLPArtifact.from_bytes(store.get_bytes("m2.npz"))
+
+
+def test_unsupported_future_format_rejected(store):
+    from cobalt_smart_lender_ai_tpu.io.artifacts import _pack
+
+    blob = _pack({}, {"kind": "gbdt", "format_version": 99, "feature_names": []})
+    with pytest.raises(ValueError, match="newer"):
+        GBDTArtifact.from_bytes(blob)

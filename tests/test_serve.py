@@ -1,0 +1,254 @@
+"""Serving-layer contract tests: response-shape parity with the reference's
+three endpoints (`cobalt_fast_api.py:96-143`), the 20-field schema with its
+two aliased names, and the stdlib HTTP adapter end-to-end over a socket."""
+
+import io
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+from cobalt_smart_lender_ai_tpu.serve import (
+    ScorerService,
+    ValidationError,
+    validate_single_input,
+)
+
+
+@pytest.fixture(scope="module")
+def serving_artifact(tmp_path_factory, engineered):
+    """Train a model on exactly the 20-feature serving contract and persist
+    it, as `model_tree_train_test.py:215-230` does."""
+    from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
+
+    tree_ff, _, _ = engineered
+    missing = [n for n in schema.SERVING_FEATURES if n not in tree_ff.feature_names]
+    assert not missing, f"synthetic frame lacks serving features: {missing}"
+    ff = tree_ff.select(schema.SERVING_FEATURES)
+    model = GBDTClassifier(n_estimators=25, max_depth=3, n_bins=64)
+    model.fit(np.asarray(ff.X), np.asarray(ff.y))
+    store = ObjectStore(str(tmp_path_factory.mktemp("serve") / "lake"))
+    art = GBDTArtifact(
+        forest=model.forest,
+        bin_spec=model.bin_spec,
+        feature_names=tuple(schema.SERVING_FEATURES),
+    )
+    art.save(store, "models/gbdt/model_tree")
+    return store, np.asarray(ff.X)
+
+
+@pytest.fixture(scope="module")
+def service(serving_artifact):
+    store, _ = serving_artifact
+    return ScorerService.from_store(store)
+
+
+def _example_payload(aliased: bool = True) -> dict:
+    vals = {
+        "loan_amnt": 9.2, "term": 36.0, "installment": 5.7,
+        "fico_range_low": 6.55, "last_fico_range_high": 690.0,
+        "open_il_12m": 1.0, "open_il_24m": 2.0, "max_bal_bc": 5000.0,
+        "num_rev_accts": 2.3, "pub_rec_bankruptcies": 0.0,
+        "emp_length_num": 5.0, "earliest_cr_line_days": 8.6,
+        "grade_E": 0, "home_ownership_MORTGAGE": 1,
+        "verification_status_Verified": 0,
+        "application_type_Joint App": 0,
+        "hardship_status_BROKEN": 0, "hardship_status_COMPLETE": 0,
+        "hardship_status_COMPLETED": 0, "hardship_status_No Hardship": 1,
+    }
+    if not aliased:
+        vals["application_type_Joint_App"] = vals.pop("application_type_Joint App")
+        vals["hardship_status_No_Hardship"] = vals.pop("hardship_status_No Hardship")
+    return vals
+
+
+# --- schema validation --------------------------------------------------------
+
+
+def test_validate_accepts_aliases_and_field_names():
+    row_a = validate_single_input(_example_payload(aliased=True))
+    row_f = validate_single_input(_example_payload(aliased=False))
+    assert row_a == row_f
+    assert set(row_a) == set(schema.SERVING_FEATURES)
+
+
+def test_validate_missing_field():
+    bad = _example_payload()
+    bad.pop("loan_amnt")
+    with pytest.raises(ValidationError, match="loan_amnt"):
+        validate_single_input(bad)
+
+
+def test_validate_rejects_non_numeric_and_non_integer():
+    bad = _example_payload()
+    bad["term"] = "36 months"
+    with pytest.raises(ValidationError, match="term"):
+        validate_single_input(bad)
+    bad2 = _example_payload()
+    bad2["grade_E"] = 0.5  # int-typed field in the reference schema
+    with pytest.raises(ValidationError, match="grade_E"):
+        validate_single_input(bad2)
+
+
+def test_validate_ignores_unknown_keys():
+    extra = {**_example_payload(), "unknown_column": 1.0}
+    assert set(validate_single_input(extra)) == set(schema.SERVING_FEATURES)
+
+
+# --- endpoint handlers --------------------------------------------------------
+
+
+def test_predict_single_response_shape(service):
+    resp = service.predict_single(_example_payload())
+    # exact key set of cobalt_fast_api.py:102-108
+    assert set(resp) == {
+        "prob_default", "shap_values", "base_value", "features", "input_row",
+    }
+    assert 0.0 <= resp["prob_default"] <= 1.0
+    assert resp["features"] == list(schema.SERVING_FEATURES)
+    assert len(resp["shap_values"]) == 20
+    assert set(resp["input_row"]) == set(schema.SERVING_FEATURES)
+    # SHAP additivity: sigmoid(base + sum(phis)) == prob_default
+    margin = resp["base_value"] + sum(resp["shap_values"])
+    prob = 1.0 / (1.0 + np.exp(-margin))
+    np.testing.assert_allclose(prob, resp["prob_default"], atol=1e-4)
+
+
+def test_predict_bulk_csv(service, serving_artifact):
+    _, X = serving_artifact
+    import pandas as pd
+
+    df = pd.DataFrame(X[:10], columns=list(schema.SERVING_FEATURES))
+    df.loc[0, "emp_length_num"] = np.nan  # must serialize as "null"
+    csv_bytes = df.to_csv(index=False).encode()
+    resp = service.predict_bulk_csv(csv_bytes)
+    assert set(resp) == {"predictions"}
+    assert len(resp["predictions"]) == 10
+    for rec in resp["predictions"]:
+        assert 0.0 <= rec["prob_default"] <= 1.0
+    assert resp["predictions"][0]["emp_length_num"] == "null"
+
+
+def test_predict_bulk_csv_missing_column(service):
+    with pytest.raises(ValidationError, match="term"):
+        service.predict_bulk_csv(b"loan_amnt\n1.0\n")
+
+
+def test_feature_importance_bulk(service):
+    resp = service.feature_importance_bulk({"data": [{"loan_amnt": 1.0}]})
+    top = resp["top_features"]
+    assert 0 < len(top) <= 10
+    assert all(set(t) == {"feature", "importance"} for t in top)
+    imps = [t["importance"] for t in top]
+    assert imps == sorted(imps, reverse=True)
+    assert all(t["feature"] in schema.SERVING_FEATURES for t in top)
+
+
+def test_feature_importance_bulk_empty_rejected(service):
+    with pytest.raises(ValidationError):
+        service.feature_importance_bulk({"data": []})
+
+
+# --- stdlib HTTP adapter end-to-end ------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def http_server(service):
+    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+
+    httpd = make_server(service, "127.0.0.1", 0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def _post(url, body: bytes, content_type: str):
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": content_type}, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_http_predict(http_server):
+    status, resp = _post(
+        http_server + "/predict",
+        json.dumps(_example_payload()).encode(),
+        "application/json",
+    )
+    assert status == 200
+    assert set(resp) == {
+        "prob_default", "shap_values", "base_value", "features", "input_row",
+    }
+
+
+def test_http_predict_422(http_server):
+    status, resp = _post(http_server + "/predict", b"{}", "application/json")
+    assert status == 422
+    assert "missing fields" in resp["detail"]
+
+
+def test_http_bulk_csv_multipart(http_server, serving_artifact):
+    _, X = serving_artifact
+    import pandas as pd
+
+    csv = pd.DataFrame(X[:3], columns=list(schema.SERVING_FEATURES)).to_csv(
+        index=False
+    )
+    boundary = "testboundary123"
+    body = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="file"; filename="rows.csv"\r\n'
+        "Content-Type: text/csv\r\n\r\n"
+        f"{csv}\r\n"
+        f"--{boundary}--\r\n"
+    ).encode()
+    status, resp = _post(
+        http_server + "/predict_bulk_csv",
+        body,
+        f"multipart/form-data; boundary={boundary}",
+    )
+    assert status == 200
+    assert len(resp["predictions"]) == 3
+
+
+def test_http_importance_400_on_empty(http_server):
+    status, resp = _post(
+        http_server + "/feature_importance_bulk",
+        json.dumps({"data": []}).encode(),
+        "application/json",
+    )
+    assert status == 400
+    assert resp["detail"] == "No data provided."
+
+
+def test_http_healthz_and_404(http_server):
+    with urllib.request.urlopen(http_server + "/healthz") as r:
+        assert r.status == 200
+    status, _ = _post(http_server + "/nope", b"{}", "application/json")
+    assert status == 404
+
+
+# --- fastapi adapter (runs only where fastapi is installed) -------------------
+
+
+def test_fastapi_adapter_if_available(service):
+    pytest.importorskip("fastapi")
+    from fastapi.testclient import TestClient
+
+    from cobalt_smart_lender_ai_tpu.serve.http_fastapi import create_app
+
+    client = TestClient(create_app(service=service))
+    r = client.post("/predict", json=_example_payload())
+    assert r.status_code == 200
+    assert set(r.json()) == {
+        "prob_default", "shap_values", "base_value", "features", "input_row",
+    }
