@@ -126,6 +126,7 @@ class RFEConfig:
     step: int = 1
     n_estimators: int = 50  # selector model can be lighter than the final model
     max_depth: int = 6
+    scale_pos_weight: float = 1.0  # reference passes it to the RFE estimator
     seed: int = 42
 
 

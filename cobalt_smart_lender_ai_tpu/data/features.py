@@ -203,13 +203,14 @@ def engineer_features(
     X_tree = jnp.concatenate(tree_blocks, axis=1)
 
     # --- nn frame: impute + indicators + label codes ---------------------
-    host_num = np.asarray(X_num)
-    nan_any = np.isnan(host_num).any(axis=0)
+    # NaN detection + medians run on device; only the (F,) bool mask comes
+    # back to host (it drives Python-level column-list construction).
+    nan_any = np.asarray(jnp.any(jnp.isnan(X_num), axis=0))
     dti_idx = numeric_names.index("dti") if "dti" in numeric_names else -1
     need_ind = nan_any.copy()
     if dti_idx >= 0:
         need_ind[dti_idx] = False  # dti handled specially below
-    medians = jnp.asarray(np.nanmedian(np.where(np.isnan(host_num), np.nan, host_num), axis=0))
+    medians = jnp.nanmedian(X_num, axis=0)
     medians = jnp.where(jnp.isnan(medians), 0.0, medians)
     X_filled, indicators = _impute_with_indicators(
         X_num, medians, jnp.asarray(need_ind)
@@ -239,8 +240,11 @@ def engineer_features(
         nn_names.append(c)
     X_nn = jnp.concatenate(nn_blocks, axis=1)
 
+    # One batched device->host fetch; per-scalar float(medians[i]) would block
+    # ~0.1s per column on this backend (67 columns = ~7s of pure sync).
+    medians_np = np.asarray(medians)
     median_map = {
-        name: float(medians[i]) for i, name in enumerate(numeric_names)
+        name: float(medians_np[i]) for i, name in enumerate(numeric_names)
     }
     plan = FeaturePlan(
         numeric_names=numeric_names,

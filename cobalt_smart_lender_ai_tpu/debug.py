@@ -1,0 +1,67 @@
+"""Numeric-safety and profiling hooks — SURVEY §5.1/§5.2.
+
+The reference has neither (its only failure handling is try/except -> HTTP
+500, SURVEY §5.3). Here:
+
+- `nan_guard()` — context manager flipping on `jax_debug_nans`, which makes
+  XLA re-run any op that produced a NaN eagerly and raise with the offending
+  primitive. Intended for CI/debug runs (it forces sync dispatch; never leave
+  it on in the hot path).
+- `assert_all_finite(tree, name)` — host-side check of a result pytree (one
+  batched device fetch), raising `FloatingPointError` naming the bad leaf.
+  For checking model params / result pytrees after a run; the train loop's
+  per-epoch divergence check (`TrainSettings.check_finite`) is a separate
+  inline scalar test at models/train_loop.py.
+- `profile_trace(dir)` — `jax.profiler.trace` wrapper for capturing a
+  TensorBoard-viewable trace of a bench/pipeline run (`bench.py --profile`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def nan_guard(enable: bool = True) -> Iterator[None]:
+    """Enable `jax_debug_nans` inside the block (restores the prior value)."""
+    if not enable:
+        yield
+        return
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def assert_all_finite(tree, name: str = "result") -> None:
+    """Raise `FloatingPointError` if any leaf of ``tree`` has NaN/inf."""
+    paths_leaves = jax.tree_util.tree_leaves_with_path(tree)
+    # One batched fetch: per-leaf np.asarray would block per device round-trip
+    # (~0.1s each on a tunneled backend).
+    host_leaves = jax.device_get([leaf for _, leaf in paths_leaves])
+    for (path, _), arr in zip(paths_leaves, host_leaves):
+        arr = np.asarray(arr)
+        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+            raise FloatingPointError(
+                f"{name}{jax.tree_util.keystr(path)} contains NaN/inf "
+                f"(shape {arr.shape})"
+            )
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | None) -> Iterator[None]:
+    """Capture a `jax.profiler` trace into ``log_dir`` (no-op when None)."""
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+__all__ = ["nan_guard", "assert_all_finite", "profile_trace"]

@@ -49,6 +49,7 @@ class TrainSettings:
     early_stop_patience: int = 5
     early_stop_min_delta: float = 1e-4
     seed: int = 0
+    check_finite: bool = True  # raise on NaN/inf epoch loss (SURVEY §5.2)
 
 
 def _num_rows(X: Batch) -> int:
@@ -153,7 +154,13 @@ def fit_binary(
     for epoch in range(s.epochs):
         rng, sub = jax.random.split(rng)
         params, opt_state, loss = train_epoch(params, opt_state, sub)
-        history["loss"].append(float(loss))
+        loss_f = float(loss)
+        if s.check_finite and not np.isfinite(loss_f):
+            raise FloatingPointError(
+                f"epoch {epoch}: training loss is {loss_f} — diverged "
+                "(inspect with cobalt_smart_lender_ai_tpu.debug.nan_guard)"
+            )
+        history["loss"].append(loss_f)
         if X_val is not None:
             auc = float(val_auc_fn(params))
             history["val_auc"].append(auc)

@@ -59,7 +59,10 @@ def rfe_select(
     bins = transform(spec, X)
     hp = GBDTHyperparams.from_config(
         GBDTConfig(
-            n_estimators=cfg.n_estimators, max_depth=cfg.max_depth, n_bins=n_bins
+            n_estimators=cfg.n_estimators,
+            max_depth=cfg.max_depth,
+            n_bins=n_bins,
+            scale_pos_weight=cfg.scale_pos_weight,
         )
     )
     rng = jax.random.PRNGKey(cfg.seed)

@@ -1,0 +1,58 @@
+"""NaN-guard / finite-check / profiler hooks (SURVEY §5.1-5.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cobalt_smart_lender_ai_tpu.debug import (
+    assert_all_finite,
+    nan_guard,
+    profile_trace,
+)
+
+
+def test_assert_all_finite_passes_and_raises():
+    assert_all_finite({"a": jnp.ones(3), "b": np.zeros(2)})
+    with pytest.raises(FloatingPointError, match="loss"):
+        assert_all_finite({"loss": jnp.array([1.0, jnp.nan])}, name="")
+
+
+def test_nan_guard_toggles_config():
+    assert not jax.config.jax_debug_nans
+    with nan_guard():
+        assert jax.config.jax_debug_nans
+        with pytest.raises(FloatingPointError):
+            jnp.log(jnp.zeros(2)) - jnp.log(jnp.zeros(2))  # inf - inf
+    assert not jax.config.jax_debug_nans
+
+
+def test_train_loop_raises_on_divergence():
+    from cobalt_smart_lender_ai_tpu.models.nn import MLP
+    from cobalt_smart_lender_ai_tpu.models.train_loop import (
+        TrainSettings,
+        fit_binary,
+    )
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.normal(size=(64, 4)).astype(np.float32))
+    y = jnp.asarray((rng.random(64) > 0.5).astype(np.float32))
+    module = MLP(hidden=(4,))
+    params = module.init(jax.random.PRNGKey(0), X[:1])
+    settings = TrainSettings(epochs=2, batch_size=32, l2=1e38)  # loss -> inf
+    with pytest.raises(FloatingPointError, match="diverged"):
+        fit_binary(
+            lambda p, xb, rngs: module.apply(p, xb), params, X, y, settings
+        )
+
+
+def test_profile_trace_writes_events(tmp_path):
+    with profile_trace(str(tmp_path / "trace")):
+        jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+    files = list((tmp_path / "trace").rglob("*"))
+    assert any(f.is_file() for f in files)
+
+
+def test_profile_trace_noop_when_disabled():
+    with profile_trace(None):
+        pass
