@@ -1,33 +1,55 @@
-"""Benchmark: full GBDT training throughput on one TPU chip.
+"""Benchmark: the full-table north-star workload, end-to-end on one chip.
 
-Trains the reference's tuned production configuration (300 trees, depth 3,
-lr 0.05 — BASELINE.md best hyperparams) on a 500k-row x 100-feature synthetic
-credit table, end-to-end on device (quantile binning + all boosting rounds),
-and reports rows/sec/chip.
+Runs the BASELINE.json north-star row count — 2.3M rows x 100 features, the
+size of the reference's full LendingClub table it never managed to train on —
+entirely on device: quantile binning, all 300 boosting rounds of the
+reference's tuned production configuration (depth 3, lr 0.05, BASELINE.md
+best hyperparams) on an 80% train split, then predict + held-out ROC-AUC.
 
-``vs_baseline`` compares against the only training throughput the reference
-ever recorded: the Keras MLP's ~26k rows/s on CPU (BASELINE.md, `04` cell 40)
-— the reference never timed its XGBoost path.
+``vs_baseline`` is the honest north-star framing (the reference records no
+XGBoost wall-clock; its only training throughput is a Keras MLP at ~26k
+rows/s on CPU): the target "2.3M rows end-to-end < 60 s on a v4-8" demands
+>= 2.3M/60/8 ~ 4,791 rows/s/chip, so ``vs_baseline = rows_per_sec /
+4791``. Values > 1 mean a single chip already beats the 8-chip budget
+pro-rata; r2 measures ~100k rows/s/chip, i.e. the whole 8-chip-minute
+workload fits on ONE chip in ~22 s.
 
-Prints exactly one JSON line.
+The fit is dispatched in 100-tree chunks (each ~7 s) to respect this
+environment's dispatch-duration limit; the timed quantity fetches the final
+AUC, forcing the full pipeline to execute.
+
+Label signal here is a quick planted logit over 10 features (test AUC ~0.91
+at this noise level) — the framework's headline-AUC parity (>= 0.95 tuned on
+the LendingClub-schema generator) is demonstrated in tests/test_pipeline.py
+and BENCH notes, not here.
+
+Prints exactly one JSON line. ``--profile DIR`` wraps the timed run in a
+`jax.profiler` trace (SURVEY §5.1).
 """
 
+import argparse
 import json
 import time
 
 import numpy as np
 
-BASELINE_ROWS_PER_SEC = 26_000.0  # reference CPU training throughput
-N_ROWS, N_FEATURES = 500_000, 100
+NORTH_STAR_ROWS_PER_SEC_PER_CHIP = 2_300_000 / 60.0 / 8  # ~4,791 (v4-8 < 60s)
+N_ROWS, N_FEATURES = 2_300_000, 100
 N_TREES, MAX_DEPTH, N_BINS = 300, 3, 64
 CHUNK_TREES = 100  # keep each dispatch well under the ~60s environment limit
 
 
 def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--profile", default=None, help="jax.profiler trace dir")
+    parser.add_argument("--rows", type=int, default=N_ROWS)
+    args = parser.parse_args()
+
     import jax
     import jax.numpy as jnp
 
     from cobalt_smart_lender_ai_tpu.config import GBDTConfig
+    from cobalt_smart_lender_ai_tpu.debug import profile_trace
     from cobalt_smart_lender_ai_tpu.models.gbdt import (
         GBDTHyperparams,
         fit_binned_chunked,
@@ -36,10 +58,11 @@ def main() -> None:
     from cobalt_smart_lender_ai_tpu.ops.binning import compute_bin_edges, transform
     from cobalt_smart_lender_ai_tpu.ops.metrics import roc_auc
 
+    n = args.rows
     rng = np.random.default_rng(0)
-    X = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
+    X = rng.normal(size=(n, N_FEATURES)).astype(np.float32)
     logits = X[:, :10] @ rng.normal(size=10) * 0.7
-    y = (logits + rng.logistic(size=N_ROWS) > 0).astype(np.int32)
+    y = (logits + rng.logistic(size=n) > 0).astype(np.int32)
     X[rng.random(X.shape) < 0.02] = np.nan  # exercise missing-value routing
 
     hp = GBDTHyperparams.from_config(
@@ -49,16 +72,19 @@ def main() -> None:
     )
     Xd = jnp.asarray(X)
     yd = jnp.asarray(y)
-    sw = jnp.ones((N_ROWS,), jnp.float32)
+    test = np.zeros(n, bool)
+    test[rng.choice(n, n // 5, replace=False)] = True
+    train_w = jnp.asarray((~test).astype(np.float32))  # 80/20 split via weights
+    test_w = jnp.asarray(test.astype(np.float32))
     fm = jnp.ones((N_FEATURES,), bool)
 
-    def run(key):
+    def run(key) -> float:
         spec = compute_bin_edges(Xd, n_bins=N_BINS)
         bins = transform(spec, Xd)
         forest = fit_binned_chunked(
             bins,
             yd,
-            sw,
+            train_w,
             fm,
             hp,
             key,
@@ -67,24 +93,30 @@ def main() -> None:
             n_bins=N_BINS,
             chunk_trees=CHUNK_TREES,
         )
-        # Fetch to force full execution (async dispatch otherwise lies).
-        np.asarray(forest.leaf_value)
-        return forest, bins
+        margin = predict_margin(forest, bins, use_binned=True)
+        # Fetching the scalar forces the whole chain to execute (async
+        # dispatch otherwise lies about wall-clock).
+        return float(roc_auc(yd.astype(jnp.float32), margin, weight=test_w))
 
     run(jax.random.PRNGKey(0))  # compile warmup
-    t0 = time.time()
-    forest, bins = run(jax.random.PRNGKey(1))
-    elapsed = time.time() - t0
-    auc = float(roc_auc(yd.astype(jnp.float32), predict_margin(forest, bins, use_binned=True)))
+    with profile_trace(args.profile):
+        t0 = time.time()
+        auc = run(jax.random.PRNGKey(1))
+        elapsed = time.time() - t0
 
-    rows_per_sec = N_ROWS / elapsed
+    rows_per_sec = n / elapsed
     print(
         json.dumps(
             {
-                "metric": "gbdt_full_train_rows_per_sec_per_chip",
+                "metric": "full_table_e2e_rows_per_sec_per_chip",
                 "value": round(rows_per_sec, 1),
-                "unit": f"rows/s (300 trees d3 {N_FEATURES}f, bin+fit, train AUC {auc:.3f})",
-                "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+                "unit": (
+                    f"rows/s ({n/1e6:.1f}M rows, bin+300-tree-fit+predict+AUC "
+                    f"in {elapsed:.1f}s, held-out AUC {auc:.3f}; "
+                    "vs_baseline = x over the 4,791 rows/s/chip the v4-8 "
+                    "<60s north star requires)"
+                ),
+                "vs_baseline": round(rows_per_sec / NORTH_STAR_ROWS_PER_SEC_PER_CHIP, 2),
             }
         )
     )

@@ -45,7 +45,10 @@ from cobalt_smart_lender_ai_tpu.ops.binning import (
     float_threshold,
     transform,
 )
-from cobalt_smart_lender_ai_tpu.ops.histogram import gradient_histogram
+from cobalt_smart_lender_ai_tpu.ops.histogram import (
+    gradient_histogram,
+    select_columns,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -240,13 +243,15 @@ def fit_binned_resumable(
             offset = n_nodes - 1
             local = node - offset
             hist = gradient_histogram(
-                bins, local, g, h, n_nodes=n_nodes, n_bins=n_bins
-            )  # (n_nodes, F, B, 2)
-            level_cover = jax.ops.segment_sum(w_pos, local, num_segments=n_nodes)
+                bins, local, g, h, w_pos, n_nodes=n_nodes, n_bins=n_bins
+            )  # (n_nodes, F, B, 3)
             if axis_name is not None:
                 hist = jax.lax.psum(hist, axis_name)
-                level_cover = jax.lax.psum(level_cover, axis_name)
+            # Node cover is the w channel summed over feature 0's bins —
+            # free by-product of the histogram pass (no scatter-add).
+            level_cover = hist[:, 0, :, 2].sum(axis=-1)
             covers = covers.at[offset : offset + n_nodes].set(level_cover)
+            hist = hist[..., :2]
             miss = hist[:, :, 0, :]  # (n_nodes, F, 2) missing-bucket sums
             cum = jnp.cumsum(hist[:, :, 1:, :], axis=2)  # (n_nodes, F, B-1, 2)
             tot = cum[:, :, -1, :] + miss  # node totals, replicated over F
@@ -288,19 +293,26 @@ def fit_binned_resumable(
                 jnp.where(do_split, best_gain, 0.0)
             )
 
-            b_row = bins[row_ids, feat_lvl[local]].astype(jnp.int32)
+            b_row = select_columns(
+                bins, feat_lvl[local], exact_max=n_bins
+            ).astype(jnp.int32)
             go_left = jnp.where(b_row == 0, ml_lvl[local], b_row <= thr_lvl[local])
             node = 2 * node + 1 + (1 - go_left.astype(jnp.int32))
 
         leaf_local = node - (2**depth_cap - 1)
-        # Per-channel 1-D segment-sums (a (N, 3) data array would tile to lane
-        # width 128 on TPU).
-        sums = jnp.stack(
-            [
-                jax.ops.segment_sum(v, leaf_local, num_segments=n_leaves)
-                for v in (g, h, w_pos)
-            ],
-            axis=-1,
+        # Leaf (g, h, cover) sums as one one-hot contraction on the MXU
+        # (scatter-free; the CPU backend's segment-sum is equally fine with
+        # this shape since n_leaves is tiny).
+        oh_leaf = jax.nn.one_hot(leaf_local, n_leaves, dtype=jnp.float32)
+        # precision=HIGHEST: leaf values feed predictions directly, so the
+        # g/h operands must not be MXU-truncated to bf16 (default precision
+        # would cost ~0.4% relative error); n_leaves is tiny, cost negligible.
+        sums = jnp.einsum(
+            "nl,nc->lc",
+            oh_leaf,
+            jnp.stack([g, h, w_pos], axis=1),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )
         if axis_name is not None:
             sums = jax.lax.psum(sums, axis_name)
@@ -435,7 +447,16 @@ def predict_margin(forest: Forest, X: jax.Array, use_binned: bool = False) -> ja
         node = jnp.zeros((N,), jnp.int32)
         for _ in range(forest.depth):
             f = feats[node]
-            x = X[row_ids, f]
+            if use_binned:
+                # one-hot contraction row-select (bins are NaN-free; uint8
+                # bins fit bf16's exact integer range, wider bins ride f32) —
+                # gathers are slow on TPU.
+                exact = 256 if X.dtype == jnp.uint8 else 2**24
+                x = select_columns(X, f, exact_max=exact)
+            else:
+                # raw floats may hold NaN, which would poison a one-hot dot
+                # (NaN * 0 = NaN); serving batches are small, keep the gather.
+                x = X[row_ids, f]
             if use_binned:
                 b = x.astype(jnp.int32)
                 go_left = jnp.where(b == 0, ml[node], b <= thr_bin[node])

@@ -1,16 +1,31 @@
-"""Gradient/hessian histograms — the hot op of histogram GBDT.
+"""Gradient/hessian/cover histograms — the hot op of histogram GBDT.
 
 XGBoost builds per-node (feature, bin) gradient histograms in multithreaded
 C++ (`hist` method). Two XLA formulations are provided:
 
 - ``segsum`` — one joint (node, feature, bin) segment-sum per channel. Ideal
-  on CPU; on TPU, XLA lowers scatter-add to a serialized loop (~17ns per
-  (row, feature) update measured on v5e) — far too slow for the hot path.
-- ``matmul`` — one-hot bin masks contracted against node-partitioned (g, h)
-  columns on the MXU, accumulated over row blocks with `lax.scan` so the
-  one-hot never materializes in HBM at full size. This is how histograms are
-  built TPU-natively: trade redundant FLOPs (xB one-hot width) for systolic
-  throughput.
+  on CPU; on TPU, XLA lowers scatter-add to a serialized loop — far too slow
+  for the hot path.
+- ``matmul`` — one-hot bin masks contracted against node-partitioned
+  (g, h, w) columns on the MXU, accumulated over row blocks with `lax.scan`
+  so the one-hot never materializes in HBM at full size. This is how
+  histograms are built TPU-natively: trade redundant FLOPs (xB one-hot
+  width) for systolic throughput.
+
+Three channels per bucket: gradient, hessian, and the row-weight "cover".
+Carrying cover as a histogram channel makes the per-level node cover a free
+by-product (sum the w channel over one feature's bins) instead of a separate
+scatter-add — measured ~5ms/level saved at 500k rows on v5e.
+
+Measured on TPU v5e (500k rows x 100 features x 64 bins, 4 nodes, amortized
+over 20 in-program reps to cancel ~110ms tunnel latency): f32 one-hot
+4.1ms/pass, **bf16 one-hot + f32 data 1.4ms/pass**. The bf16 mask is exact
+(0/1); note the MXU at default matmul precision may also round the f32
+(g, h) operand to bf16 — accepted deliberately for the histogram: split
+gains are rank statistics robust to ~0.4% operand rounding (XGBoost's own
+hist method is single-precision), accumulation stays f32, and the 0/1 cover
+channel remains exact. Leaf values, which feed predictions directly, are
+summed at Precision.HIGHEST in models/gbdt.py instead.
 
 Under a `dp`-sharded mesh each device builds partial histograms of its row
 shard and a `psum` over ICI reduces them (`parallel/sharded.py`) — the GBDT
@@ -25,7 +40,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _hist_segsum(bins, node_local, g, h, n_nodes: int, n_bins: int) -> jax.Array:
+def _hist_segsum(bins, node_local, g, h, w, n_nodes: int, n_bins: int) -> jax.Array:
     N, F = bins.shape
     feat_ids = jnp.arange(F, dtype=jnp.int32)[None, :]
     seg = (
@@ -35,26 +50,27 @@ def _hist_segsum(bins, node_local, g, h, n_nodes: int, n_bins: int) -> jax.Array
     n_segments = n_nodes * F * n_bins
 
     def channel(v: jax.Array) -> jax.Array:
-        # Per-channel 1-D segment-sums: (N·F, 2)-shaped data would be tiled to
-        # lane width 128 on TPU (64x HBM inflation); flat vectors tile cleanly.
+        # Per-channel 1-D segment-sums: (N·F, 3)-shaped data would be tiled to
+        # lane width 128 on TPU (43x HBM inflation); flat vectors tile cleanly.
         data = jnp.broadcast_to(v[:, None], (N, F)).reshape(-1)
         return jax.ops.segment_sum(data, seg, num_segments=n_segments)
 
-    out = jnp.stack([channel(g), channel(h)], axis=-1)
-    return out.reshape(n_nodes, F, n_bins, 2)
+    out = jnp.stack([channel(g), channel(h), channel(w)], axis=-1)
+    return out.reshape(n_nodes, F, n_bins, 3)
 
 
 def _hist_matmul(
-    bins, node_local, g, h, n_nodes: int, n_bins: int, row_block: int
+    bins, node_local, g, h, w, n_nodes: int, n_bins: int, row_block: int
 ) -> jax.Array:
     N, F = bins.shape
     K = n_nodes
     oh_node = jax.nn.one_hot(node_local, K, dtype=jnp.float32)  # (N, K)
     rhs = jnp.concatenate(
-        [oh_node * g[:, None], oh_node * h[:, None]], axis=1
-    )  # (N, 2K)
+        [oh_node * g[:, None], oh_node * h[:, None], oh_node * w[:, None]],
+        axis=1,
+    )  # (N, 3K) — stays f32: gradient precision is not traded away
     # Cap the block so the transient one-hot (R, F, B) stays <= 2^26 elements
-    # (256MB at f32) even if XLA fails to fuse it into the contraction.
+    # (128MB at bf16) even if XLA fails to fuse it into the contraction.
     R = min(row_block, N, max(512, (1 << 26) // max(F * n_bins, 1)))
     n_blocks = -(-N // R)
     pad = n_blocks * R - N
@@ -62,13 +78,15 @@ def _hist_matmul(
         bins = jnp.pad(bins, ((0, pad), (0, 0)))  # bin 0, but rhs pad is 0
         rhs = jnp.pad(rhs, ((0, pad), (0, 0)))
     bins_b = bins.reshape(n_blocks, R, F)
-    rhs_b = rhs.reshape(n_blocks, R, 2 * K)
+    rhs_b = rhs.reshape(n_blocks, R, 3 * K)
     iota = jnp.arange(n_bins, dtype=jnp.int32)
 
     def body(acc, xs):
         bblk, rblk = xs
+        # bf16 one-hot: exact 0/1 mask at half the bytes of f32 (3x faster
+        # pass measured on v5e); contraction accumulates in f32.
         oh = (bblk.astype(jnp.int32)[:, :, None] == iota[None, None, :]).astype(
-            jnp.float32
+            jnp.bfloat16
         )  # (R, F, B) — lives only inside the scan step
         acc = acc + jnp.einsum(
             "rfb,rk->fbk", oh, rblk, preferred_element_type=jnp.float32
@@ -76,9 +94,9 @@ def _hist_matmul(
         return acc, None
 
     acc, _ = jax.lax.scan(
-        body, jnp.zeros((F, n_bins, 2 * K), jnp.float32), (bins_b, rhs_b)
+        body, jnp.zeros((F, n_bins, 3 * K), jnp.float32), (bins_b, rhs_b)
     )
-    return acc.reshape(F, n_bins, 2, K).transpose(3, 0, 1, 2)  # (K, F, B, 2)
+    return acc.reshape(F, n_bins, 3, K).transpose(3, 0, 1, 2)  # (K, F, B, 3)
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "impl", "row_block"))
@@ -87,17 +105,42 @@ def gradient_histogram(
     node_local: jax.Array,  # (N,) int32 — row's node index within the level, [0, n_nodes)
     g: jax.Array,  # (N,) float32 gradients (already sample-weighted)
     h: jax.Array,  # (N,) float32 hessians
+    w: jax.Array,  # (N,) float32 cover weights (1.0 where the row trains)
     *,
     n_nodes: int,
     n_bins: int,
     impl: str = "auto",
     row_block: int = 32768,
 ) -> jax.Array:
-    """Return ``(n_nodes, F, n_bins, 2)`` sums of (g, h) per bucket."""
+    """Return ``(n_nodes, F, n_bins, 3)`` sums of (g, h, w) per bucket.
+
+    Node cover falls out as ``hist[:, f, :, 2].sum(-1)`` for any fixed
+    feature ``f`` (every row lands in exactly one bin per feature).
+    """
     if impl == "auto":
         impl = "segsum" if jax.default_backend() == "cpu" else "matmul"
     if impl == "segsum":
-        return _hist_segsum(bins, node_local, g, h, n_nodes, n_bins)
+        return _hist_segsum(bins, node_local, g, h, w, n_nodes, n_bins)
     if impl == "matmul":
-        return _hist_matmul(bins, node_local, g, h, n_nodes, n_bins, row_block)
+        return _hist_matmul(bins, node_local, g, h, w, n_nodes, n_bins, row_block)
     raise ValueError(f"unknown histogram impl {impl!r}")
+
+
+def select_columns(M: jax.Array, idx: jax.Array, *, exact_max: int) -> jax.Array:
+    """Row-wise column select ``M[i, idx[i]]`` as an MXU-friendly one-hot
+    contraction on TPU (a 500k-row gather costs ~3ms on v5e; the one-hot dot
+    is below measurement noise), falling back to a plain gather on CPU.
+
+    ``exact_max`` must bound the values of ``M``; when it fits bf16's integer
+    range (<= 256) the mask and data ride bf16 exactly, otherwise f32 (exact
+    to 2^24).
+    """
+    if jax.default_backend() == "cpu":
+        rows = jnp.arange(M.shape[0], dtype=jnp.int32)
+        return M[rows, idx]
+    dtype = jnp.bfloat16 if exact_max <= 256 else jnp.float32
+    oh = jax.nn.one_hot(idx, M.shape[1], dtype=dtype)
+    out = jnp.einsum(
+        "nf,nf->n", M.astype(dtype), oh, preferred_element_type=jnp.float32
+    )
+    return out.astype(M.dtype)
