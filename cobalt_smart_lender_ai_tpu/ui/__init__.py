@@ -1,0 +1,27 @@
+"""L5 UI layer — Streamlit front-end over the serving API.
+
+`core` holds every piece of UI data logic (payload assembly with alias
+renames, the SHAP-waterfall computation replacing the shap package, bulk
+result coercion, the API client) as plain testable functions; `app` is the
+Streamlit render shell (reference: src/streamlit_ui/cobalt_streamlit.py).
+"""
+
+from cobalt_smart_lender_ai_tpu.ui.core import (
+    ApiClient,
+    Waterfall,
+    build_single_payload,
+    build_waterfall,
+    coerce_results_frame,
+    importance_series,
+    render_waterfall,
+)
+
+__all__ = [
+    "ApiClient",
+    "Waterfall",
+    "build_single_payload",
+    "build_waterfall",
+    "coerce_results_frame",
+    "importance_series",
+    "render_waterfall",
+]

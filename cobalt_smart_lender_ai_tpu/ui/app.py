@@ -1,0 +1,106 @@
+"""Streamlit shell over `ui.core` — the L5 layer (cobalt_streamlit.py:1-173).
+
+Run with::
+
+    streamlit run cobalt_smart_lender_ai_tpu/ui/app.py --server.port=8001
+
+Two modes, matching the reference sidebar radio: a single-borrower form (12
+numeric inputs + 4 indicator checkboxes + hardship selectbox) posting to
+``/predict`` and rendering the SHAP waterfall, and a bulk CSV upload posting
+to ``/predict_bulk_csv`` with a results table, download button, and top-10
+gain-importance bar chart. All data logic lives in `core`; this module only
+draws. `streamlit` is an optional dependency (``pip install .[ui]``) — the
+import is deferred so the package imports cleanly without it.
+
+The API base URL comes from the ``API_URL`` env var (docker-compose wires
+``http://api:8000`` exactly as the reference's compose file does), defaulting
+to localhost for bare-metal runs.
+"""
+
+from __future__ import annotations
+
+import os
+
+from cobalt_smart_lender_ai_tpu.ui import core
+
+
+def main() -> None:
+    try:
+        import streamlit as st
+    except ImportError as e:  # pragma: no cover - exercised only without extra
+        raise ImportError(
+            "The UI needs streamlit: pip install 'cobalt-smart-lender-ai-tpu[ui]'"
+        ) from e
+    import matplotlib.pyplot as plt
+
+    client = core.ApiClient(os.environ.get("API_URL", "http://localhost:8000"))
+
+    st.set_page_config(page_title="Cobalt Loan Default Prediction", layout="wide")
+    st.title("Loan Default Risk Predictor")
+    menu = st.sidebar.radio(
+        "Select Mode", ["Single Prediction", "Bulk Prediction + SHAP"]
+    )
+
+    if menu == "Single Prediction":
+        st.subheader("Enter loan details for a single borrower")
+        col1, col2 = st.columns(2)
+        numeric: dict[str, float] = {}
+        checkboxes: dict[str, bool] = {}
+        with col1:
+            for field, label, default in core.NUMERIC_INPUTS[:7]:
+                if field == "term":
+                    numeric[field] = st.selectbox(label, [36, 60], index=0)
+                else:
+                    numeric[field] = st.number_input(label, value=default)
+        with col2:
+            for field, label, default in core.NUMERIC_INPUTS[7:]:
+                numeric[field] = st.number_input(label, value=default)
+            for field, label in core.CHECKBOX_INPUTS:
+                checkboxes[field] = st.checkbox(label)
+            hardship = st.selectbox("Hardship Status", list(core.HARDSHIP_OPTIONS))
+
+        if st.button("Predict Default Risk"):
+            try:
+                payload = core.build_single_payload(numeric, checkboxes, hardship)
+                resp = client.predict(payload)
+                st.success(
+                    f"Estimated Default Probability: {resp['prob_default']:.2%}"
+                )
+                st.subheader("SHAP Explanation")
+                wf = core.build_waterfall(resp, max_display=10)
+                fig, ax = plt.subplots(figsize=(10, 6))
+                core.render_waterfall(ax, wf)
+                plt.tight_layout()
+                st.pyplot(fig)
+            except Exception as e:
+                st.error(f"Error during prediction: {e}")
+
+    else:
+        st.subheader("Upload CSV for Bulk Inference")
+        uploaded = st.file_uploader("Upload CSV with required columns", type="csv")
+        if uploaded and st.button("Run Bulk Prediction"):
+            try:
+                records = client.predict_bulk_csv(uploaded.name, uploaded.getvalue())
+                df_result = core.coerce_results_frame(records)
+                st.subheader("Prediction Results")
+                st.dataframe(df_result)
+                st.download_button(
+                    "Download Results",
+                    df_result.to_csv(index=False),
+                    "bulk_predictions.csv",
+                )
+                st.subheader("Feature Importance (Top 10)")
+                imp = core.importance_series(
+                    client.feature_importance_bulk(records)
+                )
+                fig, ax = plt.subplots()
+                ax.barh(list(imp.index)[::-1], list(imp.values)[::-1])
+                ax.set_xlabel("Importance (gain)")
+                ax.set_title("Top 10 Important Features")
+                st.pyplot(fig)
+            except Exception as e:
+                st.error(f"Prediction or Feature Importance failed: {e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,210 @@
+"""Framework-free UI logic — everything the Streamlit shell (`app.py`) does
+except draw widgets.
+
+The reference UI (`cobalt_streamlit.py`) mixes four concerns inside Streamlit
+callbacks: building the request payload with the two alias renames (:76-82),
+calling the API (:85, :140, :159), reconstructing a SHAP explanation from the
+/predict response (:102-107), and coercing the bulk results to a numeric
+frame (:145). Here each is a plain function over JSON-shaped dicts so the
+whole UI data path is unit-testable against the in-process server without a
+browser — and the Streamlit layer stays a thin render shell.
+
+The waterfall math replaces `shap.plots.waterfall` (:109-113): the shap
+package draws from (values, base_value, data); we compute the same top-10
+ordering, residual "other features" collapse, and cumulative bar positions
+directly, then render with matplotlib.
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+import pandas as pd
+
+from cobalt_smart_lender_ai_tpu.data import schema
+
+#: The single-prediction form's numeric inputs, in the reference's widget
+#: order with its default values (cobalt_streamlit.py:46-63).
+NUMERIC_INPUTS: tuple[tuple[str, str, float], ...] = (
+    ("loan_amnt", "Loan Amount", 10000.0),
+    ("term", "Term (months)", 36.0),
+    ("installment", "Installment", 300.0),
+    ("fico_range_low", "FICO Range Low", 660.0),
+    ("last_fico_range_high", "Last FICO High", 700.0),
+    ("open_il_12m", "Open IL Last 12m", 1.0),
+    ("open_il_24m", "Open IL Last 24m", 2.0),
+    ("max_bal_bc", "Max Balance on Bank Card", 2000.0),
+    ("num_rev_accts", "Number of Revolving Accounts", 10.0),
+    ("pub_rec_bankruptcies", "Bankruptcies", 0.0),
+    ("emp_length_num", "Employment Length (years)", 3.0),
+    ("earliest_cr_line_days", "Days Since First Credit Line", 4000.0),
+)
+
+#: Checkbox indicator columns (cobalt_streamlit.py:65-68).
+CHECKBOX_INPUTS: tuple[tuple[str, str], ...] = (
+    ("grade_E", "Grade E"),
+    ("home_ownership_MORTGAGE", "Home Ownership: Mortgage"),
+    ("verification_status_Verified", "Verified Status"),
+    ("application_type_Joint_App", "Joint Application"),
+)
+
+#: Hardship selectbox options (cobalt_streamlit.py:70) — "ACTIVE" is the
+#: implicit all-zeros baseline.
+HARDSHIP_OPTIONS = ("ACTIVE", "BROKEN", "COMPLETE", "COMPLETED", "No_Hardship")
+
+
+def build_single_payload(
+    numeric: Mapping[str, float],
+    checkboxes: Mapping[str, bool],
+    hardship: str,
+) -> dict[str, float]:
+    """Assemble the /predict request body from form state, applying the two
+    alias renames (cobalt_streamlit.py:76-82) so the wire keys are the
+    canonical get_dummies names with spaces."""
+    if hardship not in HARDSHIP_OPTIONS:
+        raise ValueError(f"unknown hardship status {hardship!r}")
+    payload: dict[str, float] = {
+        field: float(numeric[field]) for field, _, _ in NUMERIC_INPUTS
+    }
+    for field, _ in CHECKBOX_INPUTS:
+        payload[field] = int(bool(checkboxes.get(field, False)))
+    for status in HARDSHIP_OPTIONS[1:]:
+        payload[f"hardship_status_{status}"] = int(hardship == status)
+    for old, new in schema.SERVING_FIELD_ALIASES.items():
+        if old in payload:
+            payload[new] = payload.pop(old)
+    return payload
+
+
+@dataclass(frozen=True)
+class WaterfallItem:
+    """One bar: feature label, signed contribution, bar start position."""
+
+    label: str
+    value: float
+    start: float
+
+
+@dataclass(frozen=True)
+class Waterfall:
+    """Data for a SHAP waterfall plot, base value at the bottom accumulating
+    to the final margin f(x) at the top (shap.plots.waterfall semantics)."""
+
+    base_value: float
+    fx: float
+    items: tuple[WaterfallItem, ...]  # drawn bottom-to-top
+
+
+def build_waterfall(
+    prediction: Mapping[str, Any], max_display: int = 10
+) -> Waterfall:
+    """Compute waterfall bars from a /predict response (the UI's shap
+    Explanation reconstruction, cobalt_streamlit.py:102-113): order features
+    by |phi| descending, keep the top ``max_display - 1``, collapse the rest
+    into one "N other features" bar drawn first (bottom), then accumulate from
+    base_value so the last bar ends at f(x) = base + sum(phi)."""
+    values = np.asarray(prediction["shap_values"], dtype=np.float64)
+    features = list(prediction["features"])
+    row = prediction["input_row"]
+    base = float(prediction["base_value"])
+    order = np.argsort(-np.abs(values))
+    shown = list(order[: max_display - 1]) if len(order) > max_display - 1 else list(order)
+    rest = [i for i in order if i not in set(shown)]
+
+    # Bottom-to-top: collapsed remainder first, then ascending |phi| so the
+    # largest contribution sits adjacent to f(x) at the top.
+    bars: list[tuple[str, float]] = []
+    if rest:
+        bars.append((f"{len(rest)} other features", float(values[rest].sum())))
+    for i in reversed(shown):
+        x = row.get(features[i])
+        label = f"{x:g} = {features[i]}" if x is not None else features[i]
+        bars.append((label, float(values[i])))
+
+    items = []
+    cum = base
+    for label, v in bars:
+        items.append(WaterfallItem(label=label, value=v, start=cum))
+        cum += v
+    return Waterfall(base_value=base, fx=cum, items=tuple(items))
+
+
+def render_waterfall(ax, wf: Waterfall, fmt: str = "{:+.2f}") -> None:
+    """Draw a Waterfall onto a matplotlib axes — the shap.plots.waterfall
+    stand-in (red = pushes toward default, blue = away)."""
+    pos_color, neg_color = "#d81b60", "#1e88e5"
+    for y, item in enumerate(wf.items):
+        ax.barh(
+            y,
+            item.value,
+            left=item.start,
+            color=pos_color if item.value >= 0 else neg_color,
+            height=0.6,
+        )
+        ax.text(
+            item.start + item.value / 2,
+            y,
+            fmt.format(item.value),
+            va="center",
+            ha="center",
+            fontsize=8,
+            color="white",
+        )
+    ax.axvline(wf.base_value, color="#999", lw=0.8, ls="--")
+    ax.set_yticks(range(len(wf.items)))
+    ax.set_yticklabels([item.label for item in wf.items], fontsize=8)
+    ax.set_xlabel(
+        f"margin (base {wf.base_value:.2f} → f(x) {wf.fx:.2f})", fontsize=8
+    )
+
+
+def coerce_results_frame(records: Sequence[Mapping[str, Any]]) -> pd.DataFrame:
+    """Bulk predictions → numeric DataFrame. The server serializes NaN cells
+    as the string "null" (reference `fillna("null")`); the UI coerces every
+    column back to numeric with NaNs allowed (cobalt_streamlit.py:142-145)."""
+    df = pd.DataFrame(list(records))
+    return df.apply(pd.to_numeric, errors="coerce")
+
+
+def importance_series(top_features: Sequence[Mapping[str, Any]]) -> pd.Series:
+    """`/feature_importance_bulk` response → Series for the barh chart
+    (cobalt_streamlit.py:163-170), highest importance first."""
+    return pd.Series(
+        {item["feature"]: float(item["importance"]) for item in top_features}
+    ).sort_values(ascending=False)
+
+
+class ApiClient:
+    """Minimal HTTP client for the three serving endpoints — the `requests`
+    calls the reference UI makes (cobalt_streamlit.py:85,140,159), pulled out
+    so tests can exercise the full wire path in-process."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, path: str, **kwargs) -> Any:
+        import requests
+
+        r = requests.post(self.base_url + path, timeout=self.timeout, **kwargs)
+        r.raise_for_status()
+        return r.json()
+
+    def predict(self, payload: Mapping[str, float]) -> dict:
+        return self._post("/predict", json=dict(payload))
+
+    def predict_bulk_csv(self, filename: str, csv_bytes: bytes) -> list[dict]:
+        resp = self._post(
+            "/predict_bulk_csv",
+            files={"file": (filename, io.BytesIO(csv_bytes), "text/csv")},
+        )
+        return resp["predictions"]
+
+    def feature_importance_bulk(
+        self, records: Sequence[Mapping[str, Any]]
+    ) -> list[dict]:
+        resp = self._post("/feature_importance_bulk", json={"data": list(records)})
+        return resp["top_features"]

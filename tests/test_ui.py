@@ -1,0 +1,127 @@
+"""UI data-path tests: form payload → served API → waterfall/bulk rendering
+data, over real HTTP against the stdlib server (no Streamlit needed — the
+render shell is `ui/app.py`; everything it computes lives in `ui/core`)."""
+
+import math
+import threading
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt
+import numpy as np
+import pandas as pd
+import pytest
+
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+from cobalt_smart_lender_ai_tpu.serve import ScorerService
+from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+from cobalt_smart_lender_ai_tpu.serve.service import validate_single_input
+from cobalt_smart_lender_ai_tpu.ui import core
+
+
+@pytest.fixture(scope="module")
+def ui_env(tmp_path_factory, engineered):
+    """Small model on the 20-feature serving contract behind a live server."""
+    from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
+
+    tree_ff, _, _ = engineered
+    ff = tree_ff.select(schema.SERVING_FEATURES)
+    model = GBDTClassifier(n_estimators=20, max_depth=3, n_bins=32)
+    model.fit(np.asarray(ff.X), np.asarray(ff.y))
+    store = ObjectStore(str(tmp_path_factory.mktemp("ui") / "lake"))
+    GBDTArtifact(
+        forest=model.forest,
+        bin_spec=model.bin_spec,
+        feature_names=tuple(schema.SERVING_FEATURES),
+    ).save(store, "models/gbdt/model_tree")
+    httpd = make_server(ScorerService.from_store(store), "127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield core.ApiClient(f"http://127.0.0.1:{httpd.server_address[1]}")
+    httpd.shutdown()
+
+
+def default_form_payload():
+    numeric = {f: d for f, _, d in core.NUMERIC_INPUTS}
+    checkboxes = {"grade_E": True, "home_ownership_MORTGAGE": True}
+    return core.build_single_payload(numeric, checkboxes, "No_Hardship")
+
+
+def test_payload_matches_serving_schema():
+    payload = default_form_payload()
+    # exactly the 20 canonical serving names, aliases already applied
+    assert set(payload) == set(schema.SERVING_FEATURES)
+    assert payload["hardship_status_No Hardship"] == 1
+    assert payload["application_type_Joint App"] == 0
+    assert payload["grade_E"] == 1
+    # and it passes the server-side schema validation unchanged
+    row = validate_single_input(payload)
+    assert row["loan_amnt"] == 10000.0
+
+
+def test_unknown_hardship_rejected():
+    numeric = {f: d for f, _, d in core.NUMERIC_INPUTS}
+    with pytest.raises(ValueError):
+        core.build_single_payload(numeric, {}, "NOT_A_STATUS")
+
+
+def test_single_prediction_waterfall_additivity(ui_env):
+    resp = ui_env.predict(default_form_payload())
+    assert 0.0 <= resp["prob_default"] <= 1.0
+    wf = core.build_waterfall(resp, max_display=10)
+    # f(x) = base + sum(phi) = logit(prob): the waterfall must land exactly
+    # on the served margin (TreeSHAP additivity surfaced through the UI path)
+    margin = math.log(resp["prob_default"] / (1 - resp["prob_default"]))
+    assert wf.fx == pytest.approx(margin, abs=1e-4)
+    assert wf.base_value == pytest.approx(resp["base_value"])
+    # bars accumulate: each starts where the previous ended
+    cum = wf.base_value
+    for item in wf.items:
+        assert item.start == pytest.approx(cum, abs=1e-9)
+        cum += item.value
+    assert cum == pytest.approx(wf.fx)
+    # 20 features, max_display 10 -> 9 shown + 1 collapsed remainder
+    assert len(wf.items) == 10
+    assert wf.items[0].label == "11 other features"
+    # shown bars ordered ascending |phi| bottom-to-top (largest next to f(x))
+    mags = [abs(i.value) for i in wf.items[1:]]
+    assert mags == sorted(mags)
+
+
+def test_waterfall_render_draws_all_bars(ui_env):
+    wf = core.build_waterfall(ui_env.predict(default_form_payload()))
+    fig, ax = plt.subplots()
+    core.render_waterfall(ax, wf)
+    assert len(ax.patches) == len(wf.items)
+    plt.close(fig)
+
+
+def test_bulk_flow_results_and_importances(ui_env, engineered):
+    tree_ff, _, _ = engineered
+    ff = tree_ff.select(schema.SERVING_FEATURES)
+    sample = pd.DataFrame(
+        np.asarray(ff.X[:8]), columns=list(schema.SERVING_FEATURES)
+    )
+    records = ui_env.predict_bulk_csv(
+        "sample.csv", sample.to_csv(index=False).encode()
+    )
+    df = core.coerce_results_frame(records)
+    assert len(df) == 8 and "prob_default" in df.columns
+    # "null" strings (server-side NaN encoding) coerced back to NaN floats
+    assert df["prob_default"].between(0, 1).all()
+    assert all(df.dtypes[c].kind in "fi" for c in df.columns)
+
+    imp = core.importance_series(ui_env.feature_importance_bulk(records))
+    assert 0 < len(imp) <= 10
+    assert list(imp.values) == sorted(imp.values, reverse=True)
+    assert all(name in schema.SERVING_FEATURES for name in imp.index)
+
+
+def test_app_module_imports_without_streamlit():
+    # the render shell must stay importable in environments without the
+    # [ui] extra (streamlit is deferred into main())
+    from cobalt_smart_lender_ai_tpu.ui import app
+
+    assert callable(app.main)
