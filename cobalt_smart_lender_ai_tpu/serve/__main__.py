@@ -8,6 +8,7 @@ falls back to the stdlib server; both expose identical routes.
 from __future__ import annotations
 
 import argparse
+import os
 
 from cobalt_smart_lender_ai_tpu.config import ServeConfig
 from cobalt_smart_lender_ai_tpu.io import ObjectStore
@@ -16,7 +17,13 @@ from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--store", default="artifacts", help="object-store URI")
+    parser.add_argument(
+        "--store",
+        # COBALT_STORE_URI is how docker-compose points the container at its
+        # artifact volume (deploy parity with the reference's S3 env wiring).
+        default=os.environ.get("COBALT_STORE_URI", "artifacts"),
+        help="object-store URI",
+    )
     parser.add_argument("--model-key", default=ServeConfig.model_key)
     parser.add_argument("--host", default=ServeConfig.host)
     parser.add_argument("--port", type=int, default=ServeConfig.port)

@@ -1,0 +1,33 @@
+# L4 serving container (reference: src/api/Dockerfile).
+#
+# The reference ships python:3.12-slim + pip requirements + uvicorn. Here the
+# image installs this package with the [serve] extra (fastapi+uvicorn) and a
+# TPU-enabled jax; on a TPU VM the container must run with the libtpu device
+# exposed (--privileged or the TPU device plugin under GKE). Off-TPU the same
+# image serves on CPU — jax falls back automatically, the scorer is the same
+# compiled program.
+#
+# Build from the repo root:  docker build -f deploy/api.Dockerfile -t cobalt-lender-api .
+FROM python:3.12-slim
+
+ENV PYTHONDONTWRITEBYTECODE=1 \
+    PYTHONUNBUFFERED=1
+
+WORKDIR /app
+
+COPY pyproject.toml README.md /app/
+COPY cobalt_smart_lender_ai_tpu /app/cobalt_smart_lender_ai_tpu
+
+# jax[tpu] pulls libtpu from the Google releases index; harmless on non-TPU
+# hosts (falls back to CPU at runtime).
+RUN pip install --upgrade pip && \
+    pip install --no-cache-dir ".[serve,s3]" && \
+    pip install --no-cache-dir "jax[tpu]" \
+      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html || true
+
+# Model artifacts are restored at startup from COBALT_STORE_URI (file:// or
+# s3://) — mount a volume or AWS credentials accordingly, mirroring the
+# reference's ~/.aws mount in docker-compose.
+EXPOSE 8000
+
+CMD ["python", "-m", "cobalt_smart_lender_ai_tpu.serve", "--host", "0.0.0.0", "--port", "8000"]

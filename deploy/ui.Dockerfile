@@ -1,0 +1,23 @@
+# L5 UI container (reference: src/streamlit_ui/Dockerfile).
+#
+# Streamlit shell over the serving API; no JAX needed here — the UI only
+# speaks HTTP to the api service.
+#
+# Build from the repo root:  docker build -f deploy/ui.Dockerfile -t cobalt-lender-ui .
+FROM python:3.12-slim
+
+ENV PYTHONDONTWRITEBYTECODE=1 \
+    PYTHONUNBUFFERED=1
+
+WORKDIR /app
+
+COPY pyproject.toml README.md /app/
+COPY cobalt_smart_lender_ai_tpu /app/cobalt_smart_lender_ai_tpu
+
+RUN pip install --upgrade pip && \
+    pip install --no-cache-dir ".[ui]" matplotlib
+
+EXPOSE 8001
+
+CMD ["streamlit", "run", "cobalt_smart_lender_ai_tpu/ui/app.py", \
+     "--server.port=8001", "--server.address=0.0.0.0"]
