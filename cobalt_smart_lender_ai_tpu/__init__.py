@@ -14,10 +14,14 @@ FastAPI LendingClub loan-default pipeline):
 - ``parallel`` — device-mesh construction, CV x hyperparameter fan-out via
                  vmap/shard_map over ICI, RFE feature selection.
 - ``explain``  — exact TreeSHAP over tree tensors, gain importances.
-- ``io``       — object-store I/O (local/file:///s3://), DVC-style content
-                 pointers, self-describing model artifacts.
+- ``io``       — object-store I/O (local/file:///s3://), a DVC-equivalent
+                 content-addressed dataset registry with md5 pins,
+                 self-describing model artifacts.
 - ``serve``    — prediction service with the reference's HTTP contract
                  (stdlib server always; FastAPI adapter where installed).
+- ``ui``       — Streamlit front-end (testable core + render shell) over the
+                 serving API; deploy manifests live in ``deploy/`` +
+                 ``docker-compose.yml`` at the repo root.
 
 The reference runs everything on CPU through native code hidden in third-party
 dependencies (libxgboost, TensorFlow, shap's C++ TreeSHAP). Here every compute

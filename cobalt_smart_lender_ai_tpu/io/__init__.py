@@ -1,6 +1,7 @@
-"""Object-store I/O and model-artifact persistence (reference capabilities
-C3 and C10): a uniform byte-blob store over local/file:///s3:// URIs, CSV
-frame round-trips, DVC-style content pointers, and self-describing model
+"""Object-store I/O, dataset versioning, and model-artifact persistence
+(reference capabilities C2, C3, C10): a uniform byte-blob store over
+local/file:///s3:// URIs, CSV frame round-trips, a DVC-equivalent
+content-addressed dataset registry with md5 pins, and self-describing model
 artifacts that let a trained model outlive its process."""
 
 from cobalt_smart_lender_ai_tpu.io.artifacts import (
@@ -12,13 +13,21 @@ from cobalt_smart_lender_ai_tpu.io.artifacts import (
     plan_to_json,
     save_metrics,
 )
+from cobalt_smart_lender_ai_tpu.io.registry import (
+    REFERENCE_RAW_PINS,
+    DatasetPin,
+    DatasetRegistry,
+)
 from cobalt_smart_lender_ai_tpu.io.store import ObjectStore
 
 __all__ = [
     "FORMAT_VERSION",
+    "DatasetPin",
+    "DatasetRegistry",
     "GBDTArtifact",
     "MLPArtifact",
     "ObjectStore",
+    "REFERENCE_RAW_PINS",
     "load_metrics",
     "plan_from_json",
     "plan_to_json",

@@ -1,0 +1,38 @@
+"""Exploration notebooks (C8): the committed notebooks must be valid
+nbformat, fully executed, and error-free — the automated stand-in for the
+reference's by-inspection notebook validation (SURVEY §4)."""
+
+import pathlib
+
+import nbformat
+import pytest
+
+NB_DIR = pathlib.Path(__file__).resolve().parent.parent / "notebooks"
+EXPECTED = [
+    "01_data_cleaning.ipynb",
+    "02_eda.ipynb",
+    "03_feature_engineering.ipynb",
+    "04_model_training.ipynb",
+]
+
+
+@pytest.mark.parametrize("name", EXPECTED)
+def test_notebook_executed_without_errors(name):
+    nb = nbformat.read(NB_DIR / name, as_version=4)
+    nbformat.validate(nb)
+    code_cells = [c for c in nb.cells if c.cell_type == "code"]
+    assert code_cells, "no code cells"
+    for cell in code_cells:
+        assert cell.execution_count is not None, "unexecuted cell committed"
+        for out in cell.get("outputs", []):
+            assert out.output_type != "error", out.get("evalue")
+
+
+def test_training_notebook_demonstrates_the_leakage_lesson():
+    nb = nbformat.read(NB_DIR / "04_model_training.ipynb", as_version=4)
+    text = "".join(c.source for c in nb.cells)
+    # the notebook must reproduce the reference's leakage discovery and the
+    # honest retrain (its cells 11-16), plus the SHAP additivity check
+    assert "drop_training_leakage" in text
+    assert "shap_values" in text
+    assert "randomized_search" in text
