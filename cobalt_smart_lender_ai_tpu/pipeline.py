@@ -190,6 +190,33 @@ def run_pipeline(
         key = model_key or cfg.serve.model_key
         artifact.save(store, key)
         save_metrics(store, key + ".metrics.json", metrics)
+        # Plot artifacts (model_tree_train_test.py:184-210): confusion-matrix
+        # heatmap + top-20 gain-importance bars, as PNG objects next to the
+        # model the way the reference uploads them to S3. matplotlib is an
+        # optional extra; without it the pipeline still completes.
+        try:
+            from cobalt_smart_lender_ai_tpu.io.plots import (
+                render_confusion_matrix,
+                render_feature_importance,
+            )
+            from cobalt_smart_lender_ai_tpu.models.gbdt import gain_importances
+            from cobalt_smart_lender_ai_tpu.ops.metrics import confusion_matrix
+
+            cm = np.asarray(
+                confusion_matrix(
+                    jax.numpy.asarray(y_test_f), jax.numpy.asarray(y_pred)
+                )
+            )
+            gains, _ = gain_importances(est.forest, len(selected))
+            store.put_bytes(
+                key + ".confusion_matrix.png", render_confusion_matrix(cm)
+            )
+            store.put_bytes(
+                key + ".feature_importance.png",
+                render_feature_importance(selected, np.asarray(gains)),
+            )
+        except ImportError as exc:  # pragma: no cover - matplotlib present in CI
+            logger.warning("plot artifacts skipped (%s)", exc)
         logger.info("artifact persisted at %s", key)
 
     return PipelineResult(

@@ -20,7 +20,7 @@ import os
 import sys
 from pathlib import Path
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
@@ -36,8 +36,12 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-import sys
-sys.path.insert(0, {root!r})
+import sys, pathlib
+_root = pathlib.Path.cwd()
+while _root != _root.parent and not (_root / "cobalt_smart_lender_ai_tpu").is_dir():
+    _root = _root.parent
+if (_root / "cobalt_smart_lender_ai_tpu").is_dir():
+    sys.path.insert(0, str(_root))  # repo checkout; else rely on installed pkg
 import warnings; warnings.filterwarnings("ignore")
 import matplotlib
 matplotlib.use("Agg")
@@ -46,8 +50,8 @@ plt.rcParams["figure.dpi"] = 72
 import numpy as np
 import pandas as pd
 import jax
-print(f"jax devices: {{len(jax.devices())}} ({{jax.devices()[0].platform}})")
-""".format(root=str(HERE.parent))
+print(f"jax devices: {len(jax.devices())} ({jax.devices()[0].platform})")
+"""
 
 
 def nb(cells) -> nbformat.NotebookNode:

@@ -4,8 +4,9 @@ reference's by-inspection notebook validation (SURVEY §4)."""
 
 import pathlib
 
-import nbformat
 import pytest
+
+nbformat = pytest.importorskip("nbformat")
 
 NB_DIR = pathlib.Path(__file__).resolve().parent.parent / "notebooks"
 EXPECTED = [

@@ -86,6 +86,16 @@ def test_intermediate_frames_round_trip(pipeline_run):
     assert 0.1 < tree["loan_default"].mean() < 0.35
 
 
+def test_plot_artifacts_emitted(pipeline_run):
+    """The reference uploads confusion-matrix + feature-importance PNGs next
+    to the model (model_tree_train_test.py:184-210); the pipeline must too."""
+    cfg, store, _ = pipeline_run
+    for suffix in (".confusion_matrix.png", ".feature_importance.png"):
+        png = store.get_bytes(cfg.serve.model_key + suffix)
+        assert png[:8] == b"\x89PNG\r\n\x1a\n", suffix
+        assert len(png) > 1000
+
+
 def test_artifact_restores_and_scores(pipeline_run):
     cfg, store, result = pipeline_run
     art = GBDTArtifact.load(store, cfg.serve.model_key)
