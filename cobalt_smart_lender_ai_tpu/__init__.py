@@ -17,6 +17,9 @@ FastAPI LendingClub loan-default pipeline):
 - ``io``       — object-store I/O (local/file:///s3://), a DVC-equivalent
                  content-addressed dataset registry with md5 pins,
                  self-describing model artifacts.
+- ``native``   — first-party C++ columnar CSV reader (the data-loader the
+                 reference delegates to pandas' C engine), built on demand
+                 with g++ and bound over ctypes; falls back to pandas.
 - ``serve``    — prediction service with the reference's HTTP contract
                  (stdlib server always; FastAPI adapter where installed).
 - ``ui``       — Streamlit front-end (testable core + render shell) over the

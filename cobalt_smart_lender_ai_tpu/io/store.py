@@ -91,8 +91,14 @@ class ObjectStore:
         self.put_bytes(key, buf.getvalue())
 
     def load_frame(self, key: str) -> pd.DataFrame:
-        """CSV object read — `load_data_from_s3` (clean_data.py:44-67)."""
-        return pd.read_csv(_io.BytesIO(self.get_bytes(key)), low_memory=False)
+        """CSV object read — `load_data_from_s3` (clean_data.py:44-67).
+
+        Parses with the first-party C++ columnar reader (`native/`) when it
+        is available, falling back to pandas' C engine otherwise — both
+        yield the same frame (tested in tests/test_native.py)."""
+        from cobalt_smart_lender_ai_tpu.native import read_csv
+
+        return read_csv(self.get_bytes(key), engine="auto")
 
     # -- content-addressed pointers (DVC-pointer capability, C2) --------------
     def write_pointer(self, key: str) -> dict:

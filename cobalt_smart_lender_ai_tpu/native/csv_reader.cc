@@ -1,0 +1,270 @@
+// Columnar CSV reader — the framework's first-party native data-loader.
+//
+// The reference's ingest path bottoms out in pandas' C CSV engine
+// (SURVEY §2.2 "DataFrame ops: CSV parse ... pandas/numpy C internals",
+// clean_data.py:44-67). This re-provides that native capability as
+// first-party C++ behind a minimal C ABI (loaded via ctypes — no pybind11
+// in the image): parse once in C++, hand Python flat typed buffers it can
+// wrap zero-copy into numpy arrays.
+//
+// Design:
+//   * RFC-4180 tokenizer: quoted fields, "" escapes, embedded commas and
+//     newlines inside quotes, CRLF/LF row terminators, final row without a
+//     trailing newline.
+//   * Two passes over the in-memory buffer. Pass 1 counts rows, infers each
+//     column's kind (numeric if every non-empty cell fully parses as a
+//     double) and sums string bytes. Pass 2 fills flat output buffers:
+//     float64 per numeric column (NaN for empty cells), and a single
+//     bytes-blob + int64 offset table per string column (Arrow-style
+//     layout). No per-cell allocations, no per-cell Python objects.
+//   * Short rows are padded with empty cells; long rows have their overflow
+//     cells ignored — matching the tolerant behavior ingest needs for
+//     hand-edited CSVs.
+//
+// ABI: every function is extern "C"; the handle is opaque. Errors come back
+// as a malloc'd message through cobalt_csv_last_error (caller frees handle
+// only; the error string lives on the handle).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Cell {
+  const char* ptr;   // into the caller's buffer, or unescape storage
+  int64_t len;
+};
+
+// Tokenizer state over one buffer. Calls `emit(col_index, cell)` per cell
+// and `end_row(n_cells)` per row. Quoted cells containing "" escapes are
+// unescaped into `scratch` (rare path; the common path is zero-copy).
+template <typename EmitCell, typename EndRow>
+void tokenize(const char* data, int64_t len, std::string& scratch,
+              EmitCell emit, EndRow end_row) {
+  int64_t i = 0;
+  while (i < len) {
+    // Unescape storage is only live within a row (cells are consumed by
+    // `emit` synchronously); keep it from growing without bound.
+    if (scratch.size() > (1 << 20)) scratch.clear();
+    int64_t col = 0;
+    bool row_has_data = false;
+    while (true) {  // one row
+      Cell cell{data + i, 0};
+      if (i < len && data[i] == '"') {
+        // Quoted field. Scan for the closing quote, handling "" escapes.
+        int64_t start = ++i;
+        bool escaped = false;
+        while (i < len) {
+          if (data[i] == '"') {
+            if (i + 1 < len && data[i + 1] == '"') { escaped = true; i += 2; }
+            else break;
+          } else {
+            ++i;
+          }
+        }
+        if (!escaped) {
+          cell.ptr = data + start;
+          cell.len = i - start;
+        } else {
+          // Unescape into scratch; scratch grows but is reused across cells.
+          size_t off = scratch.size();
+          for (int64_t j = start; j < i; ++j) {
+            scratch.push_back(data[j]);
+            if (data[j] == '"') ++j;  // skip the second quote of a pair
+          }
+          cell.ptr = scratch.data() + off;
+          cell.len = static_cast<int64_t>(scratch.size() - off);
+        }
+        if (i < len) ++i;  // consume closing quote
+      } else {
+        int64_t start = i;
+        while (i < len && data[i] != ',' && data[i] != '\n' && data[i] != '\r')
+          ++i;
+        cell.ptr = data + start;
+        cell.len = i - start;
+      }
+      if (cell.len > 0) row_has_data = true;
+      emit(col, cell);
+      ++col;
+      if (i >= len) break;
+      if (data[i] == ',') { ++i; continue; }
+      if (data[i] == '\r') { ++i; if (i < len && data[i] == '\n') ++i; break; }
+      if (data[i] == '\n') { ++i; break; }
+    }
+    // Skip blank lines (incl. the trailing one a final "\n" produces) —
+    // pandas' skip_blank_lines=True behavior. Cells already emitted for the
+    // blank row are empty and harmless; end_row is what commits a row.
+    if (col == 1 && !row_has_data) {
+      if (i >= len) break;
+      continue;
+    }
+    end_row(col);
+  }
+}
+
+bool parse_double(const Cell& c, double* out) {
+  if (c.len == 0 || c.len > 63) return false;
+  char buf[64];
+  std::memcpy(buf, c.ptr, c.len);
+  buf[c.len] = '\0';
+  char* end = nullptr;
+  double v = std::strtod(buf, &end);
+  if (end == buf) return false;  // no conversion (e.g. whitespace-only cell)
+  // Skip trailing spaces; require full consumption for "numeric".
+  while (*end == ' ') ++end;
+  if (end != buf + c.len) return false;
+  *out = v;
+  return true;
+}
+
+// pandas' default NA tokens (io.parsers STR_NA_VALUES): cells matching one
+// are missing — they neither poison numeric inference nor contribute string
+// bytes, and land as NaN / null in the output.
+bool is_na_token(const Cell& c) {
+  static const char* kTokens[] = {
+      "#N/A", "#N/A N/A", "#NA", "-1.#IND", "-1.#QNAN", "-NaN", "-nan",
+      "1.#IND", "1.#QNAN", "<NA>", "N/A", "NA", "NULL", "NaN", "None",
+      "n/a", "nan", "null"};
+  for (const char* t : kTokens) {
+    const int64_t tl = static_cast<int64_t>(std::strlen(t));
+    if (tl == c.len && std::memcmp(c.ptr, t, tl) == 0) return true;
+  }
+  return false;
+}
+
+}  // namespace
+
+struct CobaltCsvTable {
+  std::vector<std::string> names;
+  std::vector<uint8_t> kinds;              // 0 = numeric, 1 = string
+  int64_t n_rows = 0;
+  std::vector<std::vector<double>> nums;   // per numeric column
+  std::vector<std::string> str_data;       // per string column: byte blob
+  std::vector<std::vector<int64_t>> str_offsets;  // per string column: n+1
+  std::string error;
+};
+
+extern "C" {
+
+CobaltCsvTable* cobalt_csv_parse(const char* data, int64_t len) {
+  auto* t = new CobaltCsvTable();
+  std::string scratch;
+  scratch.reserve(4096);
+
+  // --- header: find its end with a quote-aware scan, tokenize that slice ---
+  int64_t header_end = 0;
+  {
+    bool in_q = false;
+    while (header_end < len) {
+      char ch = data[header_end];
+      if (ch == '"') in_q = !in_q;
+      else if (ch == '\n' && !in_q) { ++header_end; break; }
+      ++header_end;
+    }
+    tokenize(data, header_end, scratch,
+             [&](int64_t, const Cell& c) { t->names.emplace_back(c.ptr, c.len); },
+             [](int64_t) {});
+  }
+  const int64_t F = static_cast<int64_t>(t->names.size());
+  if (F == 0) { t->error = "empty header"; return t; }
+
+  const char* body = data + header_end;
+  const int64_t body_len = len - header_end;
+
+  // --- pass 1: row count + type inference + string byte totals ---
+  std::vector<uint8_t> numeric_ok(F, 1);
+  std::vector<uint8_t> saw_value(F, 0);
+  std::vector<int64_t> str_bytes(F, 0);
+  int64_t n_rows = 0;
+  scratch.clear();
+  tokenize(body, body_len, scratch,
+           [&](int64_t col, const Cell& c) {
+             if (col >= F) return;
+             if (c.len == 0 || is_na_token(c)) return;  // missing
+             str_bytes[col] += c.len;
+             saw_value[col] = 1;
+             double v;
+             if (numeric_ok[col] && !parse_double(c, &v)) numeric_ok[col] = 0;
+           },
+           [&](int64_t) { ++n_rows; });
+  t->n_rows = n_rows;
+  t->kinds.resize(F);
+  for (int64_t j = 0; j < F; ++j)
+    // All-empty columns stay numeric (all-NaN), like pandas.
+    t->kinds[j] = (numeric_ok[j] || !saw_value[j]) ? 0 : 1;
+
+  // --- allocate outputs ---
+  t->nums.resize(F);
+  t->str_data.resize(F);
+  t->str_offsets.resize(F);
+  for (int64_t j = 0; j < F; ++j) {
+    if (t->kinds[j] == 0) {
+      t->nums[j].resize(n_rows, std::nan(""));
+    } else {
+      t->str_data[j].reserve(str_bytes[j]);
+      t->str_offsets[j].reserve(n_rows + 1);
+      t->str_offsets[j].push_back(0);
+    }
+  }
+
+  // --- pass 2: fill ---
+  int64_t row = 0;
+  scratch.clear();
+  tokenize(body, body_len, scratch,
+           [&](int64_t col, const Cell& c) {
+             if (col >= F) return;
+             if (t->kinds[col] == 0) {
+               double v;
+               if (c.len > 0 && parse_double(c, &v)) t->nums[col][row] = v;
+             } else if (c.len > 0 && !is_na_token(c)) {
+               t->str_data[col].append(c.ptr, c.len);
+             }
+           },
+           [&](int64_t cols_seen) {
+             // Close out string offsets (also pads short rows: a column the
+             // row never reached gets a zero-length cell).
+             for (int64_t j = 0; j < F; ++j)
+               if (t->kinds[j] == 1)
+                 t->str_offsets[j].push_back(
+                     static_cast<int64_t>(t->str_data[j].size()));
+             (void)cols_seen;
+             ++row;
+           });
+  return t;
+}
+
+int64_t cobalt_csv_nrows(CobaltCsvTable* t) { return t->n_rows; }
+int64_t cobalt_csv_ncols(CobaltCsvTable* t) {
+  return static_cast<int64_t>(t->names.size());
+}
+const char* cobalt_csv_col_name(CobaltCsvTable* t, int64_t j) {
+  return t->names[j].c_str();
+}
+int cobalt_csv_col_kind(CobaltCsvTable* t, int64_t j) { return t->kinds[j]; }
+const char* cobalt_csv_last_error(CobaltCsvTable* t) {
+  return t->error.empty() ? nullptr : t->error.c_str();
+}
+
+// Numeric column: copy n_rows doubles into caller-allocated `out`.
+void cobalt_csv_col_numeric(CobaltCsvTable* t, int64_t j, double* out) {
+  std::memcpy(out, t->nums[j].data(), sizeof(double) * t->n_rows);
+}
+
+// String column, Arrow-style: total data bytes, then fill caller buffers.
+int64_t cobalt_csv_col_str_bytes(CobaltCsvTable* t, int64_t j) {
+  return static_cast<int64_t>(t->str_data[j].size());
+}
+void cobalt_csv_col_str_fill(CobaltCsvTable* t, int64_t j, char* data,
+                             int64_t* offsets) {
+  std::memcpy(data, t->str_data[j].data(), t->str_data[j].size());
+  std::memcpy(offsets, t->str_offsets[j].data(),
+              sizeof(int64_t) * (t->n_rows + 1));
+}
+
+void cobalt_csv_free(CobaltCsvTable* t) { delete t; }
+
+}  // extern "C"

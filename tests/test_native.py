@@ -1,0 +1,147 @@
+"""First-party native data-loader (C++ CSV reader, `native/csv_reader.cc`):
+parity with pandas' C engine on the reference's data shapes — the capability
+SURVEY §2.2 lists as "DataFrame ops: CSV parse ... pandas/numpy C internals".
+
+Skips wholesale if no C++ toolchain is available (the reader then falls back
+to pandas at runtime; `test_fallback_when_disabled` still covers that path).
+"""
+
+import io
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cobalt_smart_lender_ai_tpu import native
+
+
+def _native_or_skip():
+    if not native.native_available():
+        pytest.skip("no C++ toolchain; native reader unavailable")
+
+
+def _assert_frames_match(ours: pd.DataFrame, ref: pd.DataFrame):
+    assert list(ours.columns) == list(ref.columns)
+    assert len(ours) == len(ref)
+    for col in ref.columns:
+        if pd.api.types.is_numeric_dtype(ref[col]):
+            # strtod and pandas' float parser may disagree in the last ulp
+            np.testing.assert_allclose(
+                ours[col].to_numpy(dtype=np.float64),
+                ref[col].to_numpy(dtype=np.float64),
+                rtol=1e-12,
+                atol=0,
+                equal_nan=True,
+                err_msg=col,
+            )
+        else:
+            a = ours[col].fillna("").astype(str).tolist()
+            b = ref[col].fillna("").astype(str).tolist()
+            assert a == b, col
+
+
+def test_rfc4180_edge_cases_match_pandas():
+    _native_or_skip()
+    csv = (
+        b"a,b c,d\n"  # header with a space
+        b'1,"hello, world",x\n'
+        b'2,"quote "" inside",\n'
+        b'3,"multi\nline cell",y\r\n'  # embedded newline + CRLF terminator
+        b",plain,z\n"
+        b"\n"  # blank line mid-file is skipped
+        b"4e-2,  ,w"  # trailing row without newline; whitespace-only cell
+    )
+    ours = native.read_csv(csv, engine="native")
+    ref = pd.read_csv(io.BytesIO(csv))
+    _assert_frames_match(ours, ref)
+
+
+def test_synthetic_lendingclub_round_trip():
+    """The real workload: the full-schema synthetic frame (mixed numeric /
+    string / empty cells) written by `save_frame`, parsed back natively."""
+    _native_or_skip()
+    from cobalt_smart_lender_ai_tpu.data.synthetic import (
+        synthetic_lendingclub_frame,
+    )
+
+    raw = synthetic_lendingclub_frame(2000, seed=3)
+    buf = io.BytesIO()
+    raw.to_csv(buf, index=False)
+    data = buf.getvalue()
+    ours = native.read_csv(data, engine="native")
+    ref = pd.read_csv(io.BytesIO(data), low_memory=False)
+    _assert_frames_match(ours, ref)
+
+
+def test_numeric_inference_rules():
+    _native_or_skip()
+    csv = b"i,f,mixed,empty,nan_token\n1,1.5,1,,nan\n2,-2e3,x,,3\n"
+    cols = native.parse_csv_columns(csv)
+    assert isinstance(cols["i"], np.ndarray) and cols["i"].dtype == np.float64
+    assert isinstance(cols["f"], np.ndarray)
+    assert isinstance(cols["mixed"], list)  # "x" poisons numeric inference
+    assert isinstance(cols["empty"], np.ndarray)  # all-empty stays numeric
+    assert np.isnan(cols["empty"]).all()
+    assert np.isnan(cols["nan_token"][0]) and cols["nan_token"][1] == 3.0
+
+
+def test_whitespace_only_cell_is_not_zero():
+    """A whitespace-only cell must not parse as 0.0 (strtod's no-conversion
+    case) — it makes the column string-typed, as pandas does."""
+    _native_or_skip()
+    csv = b"a,b\n1,x\n  ,y\n2,z\n"
+    ours = native.read_csv(csv, engine="native")
+    ref = pd.read_csv(io.BytesIO(csv))
+    _assert_frames_match(ours, ref)
+    assert not pd.api.types.is_numeric_dtype(ours["a"])
+
+
+def test_pandas_na_tokens_recognized():
+    """pandas' default NA tokens (NA, N/A, NULL, None, <NA>, ...) must be
+    missing values under the native engine too — same float64 dtype, same
+    NaNs — or the pipeline would behave differently with/without g++."""
+    _native_or_skip()
+    csv = b"a,s\n1,x\nNA,NULL\n2,None\nN/A,<NA>\n"
+    ours = native.read_csv(csv, engine="native")
+    ref = pd.read_csv(io.BytesIO(csv))
+    _assert_frames_match(ours, ref)
+    assert pd.api.types.is_numeric_dtype(ours["a"])
+    np.testing.assert_allclose(
+        ours["a"].to_numpy(np.float64), [1.0, np.nan, 2.0, np.nan], equal_nan=True
+    )
+    assert ours["s"].isna().tolist() == [False, True, True, True]
+
+
+def test_short_and_long_rows_tolerated():
+    _native_or_skip()
+    csv = b"a,b,c\n1,x\n2,y,3,EXTRA\n"
+    ours = native.read_csv(csv, engine="native")
+    assert len(ours) == 2
+    assert np.isnan(ours["c"].to_numpy(np.float64)[0])  # short row padded
+    assert ours["c"].to_numpy(np.float64)[1] == 3.0  # overflow cell dropped
+
+
+def test_store_load_frame_uses_reader(tmp_path):
+    """ObjectStore.load_frame round-trips a frame through whichever engine
+    is active (native where built, pandas otherwise)."""
+    from cobalt_smart_lender_ai_tpu.io import ObjectStore
+
+    store = ObjectStore(str(tmp_path / "lake"))
+    df = pd.DataFrame({"x": [1.0, np.nan, 3.0], "s": ["a", None, "c,d"]})
+    store.save_frame("t.csv", df)
+    out = store.load_frame("t.csv")
+    np.testing.assert_allclose(
+        out["x"].to_numpy(np.float64), [1.0, np.nan, 3.0], equal_nan=True
+    )
+    assert out["s"].fillna("").tolist() == ["a", "", "c,d"]
+
+
+def test_fallback_when_disabled(monkeypatch):
+    """engine='auto' must work with the native reader force-disabled."""
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "_LIB_ERR", "disabled for test")
+    csv = b"a,b\n1,x\n"
+    df = native.read_csv(csv, engine="auto")
+    assert df["a"].tolist() == [1] and df["b"].tolist() == ["x"]
+    with pytest.raises(RuntimeError):
+        native.parse_csv_columns(csv)
