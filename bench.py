@@ -11,8 +11,9 @@ XGBoost wall-clock; its only training throughput is a Keras MLP at ~26k
 rows/s on CPU): the target "2.3M rows end-to-end < 60 s on a v4-8" demands
 >= 2.3M/60/8 ~ 4,791 rows/s/chip, so ``vs_baseline = rows_per_sec /
 4791``. Values > 1 mean a single chip already beats the 8-chip budget
-pro-rata; r2 measures ~100k rows/s/chip, i.e. the whole 8-chip-minute
-workload fits on ONE chip in ~22 s.
+pro-rata; r2 measures ~140k rows/s/chip (after the histogram row-block
+sweep, models/gbdt.py hist_row_block), i.e. the whole 8-chip-minute
+workload fits on ONE chip in ~16 s.
 
 The fit is dispatched in 100-tree chunks (each ~7 s) to respect this
 environment's dispatch-duration limit; the timed quantity fetches the final

@@ -162,7 +162,9 @@ def _split_gain(GL, HL, GR, HR, Gt, Ht, reg_lambda, gamma):
 
 @partial(
     jax.jit,
-    static_argnames=("n_trees_cap", "depth_cap", "n_bins", "axis_name"),
+    static_argnames=(
+        "n_trees_cap", "depth_cap", "n_bins", "axis_name", "hist_row_block"
+    ),
 )
 def fit_binned_resumable(
     bins: jax.Array,  # (N, F) uint8/int32
@@ -178,12 +180,17 @@ def fit_binned_resumable(
     axis_name: str | None = None,
     init_margin: jax.Array | None = None,
     tree_offset: jax.Array | int = 0,
+    hist_row_block: int = 4096,
 ) -> tuple[Forest, jax.Array]:
     """Train ``n_trees_cap`` boosting rounds starting from ``init_margin``,
     returning (forest chunk, final margin) so a long run can be split across
     dispatches (`fit_binned_chunked`) — this environment kills any single
     dispatch running over ~60s. Tree indices are globally offset by
     ``tree_offset`` for RNG streams and the `n_estimators` mask.
+    ``hist_row_block`` is the histogram pass's row-block length; the default
+    comes from a sweep at the full-table bench shape (2.3M x 100 x 64 bins,
+    v5e): 1k-4k blocks all reach ~48ms/tree, 10k+ degrade to ~68-73ms/tree
+    (bigger one-hot transients schedule worse), so 4096 is the pick.
 
     One XLA program: scan over trees, unrolled level loop, one histogram pass
     per level. With ``axis_name`` set (inside `shard_map` over a row-sharded
@@ -243,7 +250,14 @@ def fit_binned_resumable(
             offset = n_nodes - 1
             local = node - offset
             hist = gradient_histogram(
-                bins, local, g, h, w_pos, n_nodes=n_nodes, n_bins=n_bins
+                bins,
+                local,
+                g,
+                h,
+                w_pos,
+                n_nodes=n_nodes,
+                n_bins=n_bins,
+                row_block=hist_row_block,
             )  # (n_nodes, F, B, 3)
             if axis_name is not None:
                 hist = jax.lax.psum(hist, axis_name)

@@ -25,6 +25,7 @@
 // as a malloc'd message through cobalt_csv_last_error (caller frees handle
 // only; the error string lives on the handle).
 
+#include <charconv>
 #include <cmath>
 #include <cstdint>
 #include <cstdlib>
@@ -37,6 +38,7 @@ namespace {
 struct Cell {
   const char* ptr;   // into the caller's buffer, or unescape storage
   int64_t len;
+  bool quoted;       // a quoted-empty cell ("") is data, not a blank line
 };
 
 // Tokenizer state over one buffer. Calls `emit(col_index, cell)` per cell
@@ -53,8 +55,9 @@ void tokenize(const char* data, int64_t len, std::string& scratch,
     int64_t col = 0;
     bool row_has_data = false;
     while (true) {  // one row
-      Cell cell{data + i, 0};
+      Cell cell{data + i, 0, false};
       if (i < len && data[i] == '"') {
+        cell.quoted = true;
         // Quoted field. Scan for the closing quote, handling "" escapes.
         int64_t start = ++i;
         bool escaped = false;
@@ -87,7 +90,7 @@ void tokenize(const char* data, int64_t len, std::string& scratch,
         cell.ptr = data + start;
         cell.len = i - start;
       }
-      if (cell.len > 0) row_has_data = true;
+      if (cell.len > 0 || cell.quoted) row_has_data = true;
       emit(col, cell);
       ++col;
       if (i >= len) break;
@@ -107,18 +110,17 @@ void tokenize(const char* data, int64_t len, std::string& scratch,
 }
 
 bool parse_double(const Cell& c, double* out) {
-  if (c.len == 0 || c.len > 63) return false;
-  char buf[64];
-  std::memcpy(buf, c.ptr, c.len);
-  buf[c.len] = '\0';
-  char* end = nullptr;
-  double v = std::strtod(buf, &end);
-  if (end == buf) return false;  // no conversion (e.g. whitespace-only cell)
-  // Skip trailing spaces; require full consumption for "numeric".
-  while (*end == ' ') ++end;
-  if (end != buf + c.len) return false;
-  *out = v;
-  return true;
+  // std::from_chars: locale-independent (strtod honors LC_NUMERIC and
+  // accepts C99 hex floats — both diverge from pandas), no whitespace or
+  // '0x' acceptance, handles inf/nan tokens like pandas does.
+  const char* p = c.ptr;
+  const char* end = c.ptr + c.len;
+  while (p < end && *p == ' ') ++p;    // pandas tolerates padded cells
+  while (end > p && end[-1] == ' ') --end;
+  if (p < end && *p == '+') ++p;       // from_chars rejects a leading '+'
+  if (p == end) return false;
+  auto res = std::from_chars(p, end, *out, std::chars_format::general);
+  return res.ec == std::errc() && res.ptr == end;
 }
 
 // pandas' default NA tokens (io.parsers STR_NA_VALUES): cells matching one

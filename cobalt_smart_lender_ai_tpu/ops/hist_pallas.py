@@ -1,0 +1,147 @@
+"""Pallas TPU kernel for the gradient-histogram hot op — written to test
+whether hand scheduling beats XLA's `matmul` formulation. **Measured answer:
+no.** At 2.3M rows x 100 features x 64 bins on v5e, a standalone pass is
+~52ms (XLA matmul: ~47ms) and a full 300-tree fit is 40.7s with this kernel
+vs 20.2s with the XLA path — XLA pipelines the one-hot build + narrow dot
+across the level's row blocks better than this straightforward kernel, and
+both formulations are bound by the same VPU-side one-hot construction rate
+(cost is n_nodes-independent in both). The kernel is kept as
+``gradient_histogram(..., impl="pallas")`` — correct, tested, and a working
+example of the VMEM-resident-accumulator pattern — but `impl="auto"` picks
+the XLA matmul on TPU (SURVEY §7 hard part (a): "Pallas kernel for
+scatter-add *if XLA's is insufficient*" — it is sufficient).
+
+Formulation (same math as `_hist_matmul`):
+
+    out[f*B + b, c] = sum_r [bins[r, f] == b] * rhs[r, c]
+
+with ``rhs = node_one_hot * (g | h | w)`` of width ``C = 3 * n_nodes``.
+Grid iterates over row blocks; the (F*B, C) accumulator lives in VMEM across
+the whole grid (constant output index map) and is written back once. Each
+row block loops over feature tiles of ``FT`` features, building a
+(R, FT*B) bf16 one-hot (exact: values are 0/1) and issuing one
+``dot_general`` per tile — M = FT*B is MXU-friendly (~512), the contraction
+K = R is long, and the narrow N = C rides the lanes.
+
+Supported for the shapes GBDT training produces (C <= 128 and accumulator
+<= a few MB, see `pallas_supported`). Numerics match `_hist_matmul` to f32
+accumulation order (both accumulate in f32 from exact bf16 one-hots;
+max observed deviation 8e-6 at 100k rows).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _pick_tiles(F: int, n_bins: int) -> tuple[int, int]:
+    """(row_block, feature_tile): keep every VMEM-resident buffer (one-hot
+    tile, bin-id pattern, accumulator) comfortably under the ~16MB scoped
+    VMEM budget while the dot's N dimension (FT * n_bins ~ 512) fills the
+    lanes and the contraction K = row_block stays long."""
+    ft = max(1, 512 // n_bins)
+    return 1024, ft
+
+
+def _hist_kernel(bins_ref, rhs_ref, out_ref, *, n_bins: int, ft: int):
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # int32 compares (Mosaic rejects bf16 equality on this target); the
+    # resulting one-hot is cast to bf16 for the MXU.
+    b32 = bins_ref[:].astype(jnp.int32)  # (R, F_pad)
+    R = b32.shape[0]
+    n_tiles = b32.shape[1] // ft
+    tile_cols = ft * n_bins
+    # pltpu.repeat tiles the block (f0 f1 f0 f1 ...), so the one-hot column
+    # layout is bin-major: col = bin * ft + f_local.
+    bin_id = jax.lax.broadcasted_iota(jnp.int32, (R, tile_cols), 1) // ft
+    rhs = rhs_ref[:]
+    for t in range(n_tiles):  # static unroll: F_pad/ft tiles
+        tile = b32[:, t * ft : (t + 1) * ft]  # (R, ft)
+        rep = pltpu.repeat(tile, n_bins, 1)  # (R, ft*B), tile-repeated
+        oh = (rep == bin_id).astype(jnp.bfloat16)  # (R, ft*B) exact 0/1
+        # Output rides (C, cols): C = 3K is narrow (<= 128), so keeping it on
+        # the sublane side makes the accumulator ~C x F*B instead of a
+        # lane-padded (F*B, 128) buffer — 8x less VMEM.
+        acc = jax.lax.dot_general(
+            rhs,
+            oh,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (C, ft*B)
+        out_ref[:, t * tile_cols : (t + 1) * tile_cols] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "n_bins", "interpret"))
+def hist_pallas(
+    bins: jax.Array,  # (N, F) uint8/int32 bin indices
+    node_local: jax.Array,  # (N,) int32 in [0, n_nodes)
+    g: jax.Array,
+    h: jax.Array,
+    w: jax.Array,
+    *,
+    n_nodes: int,
+    n_bins: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in for `_hist_matmul`: returns (n_nodes, F, n_bins, 3)."""
+    N, F = bins.shape
+    K = n_nodes
+    C = 3 * K
+    R, ft = _pick_tiles(F, n_bins)
+
+    oh_node = jax.nn.one_hot(node_local, K, dtype=jnp.float32)
+    rhs = jnp.concatenate(
+        [oh_node * g[:, None], oh_node * h[:, None], oh_node * w[:, None]],
+        axis=1,
+    )  # (N, 3K) f32 — channel-major: [g x K | h x K | w x K]
+
+    F_pad = -(-F // ft) * ft
+    N_pad = -(-N // R) * R
+    if F_pad != F:
+        bins = jnp.pad(bins, ((0, 0), (0, F_pad - F)))
+    if N_pad != N:
+        # Padded rows carry rhs = 0, so their one-hot hits contribute nothing.
+        bins = jnp.pad(bins, ((0, N_pad - N), (0, 0)))
+        rhs = jnp.pad(rhs, ((0, N_pad - N), (0, 0)))
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, n_bins=n_bins, ft=ft),
+        grid=(N_pad // R,),
+        in_specs=[
+            pl.BlockSpec((R, F_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, C), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (C, F_pad * n_bins), lambda i: (0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((C, F_pad * n_bins), jnp.float32),
+        interpret=interpret,
+    )(bins, rhs)
+
+    # Column layout: tile-major, then bin, then feature-within-tile (see the
+    # pltpu.repeat note in the kernel). C layout: channel-major [g|h|w] x K.
+    n_tiles = F_pad // ft
+    arr = out.reshape(3, K, n_tiles, n_bins, ft)
+    arr = arr.transpose(1, 2, 4, 3, 0)  # (K, n_tiles, ft, B, 3)
+    return arr.reshape(K, F_pad, n_bins, 3)[:, :F]
+
+
+def pallas_supported(F: int, n_bins: int, n_nodes: int) -> bool:
+    """Shape guard: C must ride one lane register and the VMEM-resident
+    accumulator must stay small."""
+    C = 3 * n_nodes
+    _, ft = _pick_tiles(F, n_bins)
+    F_pad = -(-F // ft) * ft
+    acc_bytes = F_pad * n_bins * C * 4
+    return C <= 128 and acc_bytes <= (6 << 20)
+
+
+__all__ = ["hist_pallas", "pallas_supported"]

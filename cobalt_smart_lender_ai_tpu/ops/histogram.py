@@ -17,15 +17,18 @@ Carrying cover as a histogram channel makes the per-level node cover a free
 by-product (sum the w channel over one feature's bins) instead of a separate
 scatter-add — measured ~5ms/level saved at 500k rows on v5e.
 
-Measured on TPU v5e (500k rows x 100 features x 64 bins, 4 nodes, amortized
-over 20 in-program reps to cancel ~110ms tunnel latency): f32 one-hot
-4.1ms/pass, **bf16 one-hot + f32 data 1.4ms/pass**. The bf16 mask is exact
-(0/1); note the MXU at default matmul precision may also round the f32
-(g, h) operand to bf16 — accepted deliberately for the histogram: split
+Measured on TPU v5e: the bf16 one-hot beats f32 ~3x (500k-row microbench),
+and inside the full fit at bench scale (2.3M rows x 100 features x 64 bins,
+depth 3) the whole three-pass-per-tree loop runs at ~48ms/tree with the
+swept 4096-row block (`models/gbdt.py` hist_row_block). The bf16 mask is
+exact (0/1); note the MXU at default matmul precision may also round the
+f32 (g, h) operand to bf16 — accepted deliberately for the histogram: split
 gains are rank statistics robust to ~0.4% operand rounding (XGBoost's own
 hist method is single-precision), accumulation stays f32, and the 0/1 cover
 channel remains exact. Leaf values, which feed predictions directly, are
-summed at Precision.HIGHEST in models/gbdt.py instead.
+summed at Precision.HIGHEST in models/gbdt.py instead. A hand-written
+Pallas kernel (`ops/hist_pallas.py`) was benchmarked against this
+formulation and lost ~2x in-fit; see its docstring for the numbers.
 
 Under a `dp`-sharded mesh each device builds partial histograms of its row
 shard and a `psum` over ICI reduces them (`parallel/sharded.py`) — the GBDT
@@ -69,9 +72,11 @@ def _hist_matmul(
         [oh_node * g[:, None], oh_node * h[:, None], oh_node * w[:, None]],
         axis=1,
     )  # (N, 3K) — stays f32: gradient precision is not traded away
-    # Cap the block so the transient one-hot (R, F, B) stays <= 2^26 elements
-    # (128MB at bf16) even if XLA fails to fuse it into the contraction.
-    R = min(row_block, N, max(512, (1 << 26) // max(F * n_bins, 1)))
+    # Cap the block so the transient one-hot (R, F, B) stays <= 2^27 elements
+    # (256MB at bf16) even if XLA fails to fuse it into the contraction;
+    # callers can pick smaller blocks via row_block (swept at bench scale:
+    # see fit_binned's hist_row_block).
+    R = min(row_block, N, max(512, (1 << 27) // max(F * n_bins, 1)))
     n_blocks = -(-N // R)
     pad = n_blocks * R - N
     if pad:
@@ -118,11 +123,20 @@ def gradient_histogram(
     feature ``f`` (every row lands in exactly one bin per feature).
     """
     if impl == "auto":
+        # "matmul" wins on TPU: a hand-written Pallas kernel (ops/hist_pallas)
+        # was benchmarked at 2.3M x 100 x 64 and LOST in-fit (300-tree fit
+        # 40.7s pallas vs 20.2s matmul on v5e) — XLA pipelines the one-hot +
+        # narrow-dot chain across the level's row blocks better than the
+        # straightforward kernel. It remains available as impl="pallas".
         impl = "segsum" if jax.default_backend() == "cpu" else "matmul"
     if impl == "segsum":
         return _hist_segsum(bins, node_local, g, h, w, n_nodes, n_bins)
     if impl == "matmul":
         return _hist_matmul(bins, node_local, g, h, w, n_nodes, n_bins, row_block)
+    if impl == "pallas":
+        from cobalt_smart_lender_ai_tpu.ops.hist_pallas import hist_pallas
+
+        return hist_pallas(bins, node_local, g, h, w, n_nodes=n_nodes, n_bins=n_bins)
     raise ValueError(f"unknown histogram impl {impl!r}")
 
 

@@ -1,5 +1,11 @@
-"""Mesh-parallel execution: dp row sharding, CV x HPO fan-out, RFE."""
+"""Mesh-parallel execution: dp row sharding, CV x HPO fan-out, RFE, and the
+multi-host distributed runtime (process bootstrap + topology-aware meshes)."""
 
+from cobalt_smart_lender_ai_tpu.parallel.distributed import (
+    DistributedConfig,
+    init_distributed,
+    make_global_mesh,
+)
 from cobalt_smart_lender_ai_tpu.parallel.mesh import make_mesh, pad_rows
 from cobalt_smart_lender_ai_tpu.parallel.rfe import RFEResult, rfe_select
 from cobalt_smart_lender_ai_tpu.parallel.sharded import fit_binned_dp, predict_margin_dp
@@ -13,6 +19,9 @@ from cobalt_smart_lender_ai_tpu.parallel.tune import (
 )
 
 __all__ = [
+    "DistributedConfig",
+    "init_distributed",
+    "make_global_mesh",
     "make_mesh",
     "pad_rows",
     "fit_binned_dp",

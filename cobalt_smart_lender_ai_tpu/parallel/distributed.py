@@ -1,0 +1,136 @@
+"""Multi-host distributed runtime — the communication-backend layer.
+
+The reference has no distributed backend at all (SURVEY §5.8: one process,
+joblib forks + OpenMP inside XGBoost). TPU-natively the equivalent is not an
+NCCL/MPI re-implementation but process bootstrap + mesh topology: each host
+runs one process, `jax.distributed.initialize` wires them into a single JAX
+runtime, and every collective the framework already issues (the psum'd
+histograms in `parallel/sharded.py`, XLA's gradient all-reduces) then rides
+ICI within a slice and DCN across slices — XLA inserts and schedules the
+transfers from the sharding annotations alone.
+
+Two things live here:
+
+- `init_distributed(cfg)` — idempotent process bootstrap. On single-host
+  (including this repo's tests and the CI dry run) it is a no-op; on a pod
+  it forwards coordinator address / process count / process id, from config
+  or the standard env vars (COORDINATOR_ADDRESS etc.) that TPU VMs carry.
+- `make_global_mesh(cfg)` — the multi-host (hp, dp) mesh. Device order
+  matters at scale: `hp` (the CV x HPO job fan-out, whose jobs never talk
+  to each other) is laid out across the *outer / DCN-ish* axis, while `dp`
+  (whose psum-reduced histograms are latency-critical) stays contiguous on
+  the *inner / ICI* axis of each slice. With one slice this degenerates to
+  `mesh.make_mesh`, so all single-host call sites keep working unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+import jax
+from jax.sharding import Mesh
+
+from cobalt_smart_lender_ai_tpu.config import MeshConfig
+from cobalt_smart_lender_ai_tpu.parallel.mesh import make_mesh
+
+logger = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """Process-bootstrap settings (all optional: unset means single-process,
+    or auto-detection from the TPU VM metadata/env that
+    `jax.distributed.initialize()` performs natively)."""
+
+    coordinator_address: str | None = None  # "host:port" of process 0
+    num_processes: int | None = None
+    process_id: int | None = None
+
+    @staticmethod
+    def from_env() -> "DistributedConfig":
+        def _int(name: str) -> int | None:
+            v = os.environ.get(name)
+            return int(v) if v else None
+
+        return DistributedConfig(
+            coordinator_address=os.environ.get("COORDINATOR_ADDRESS") or None,
+            num_processes=_int("NUM_PROCESSES"),
+            process_id=_int("PROCESS_ID"),
+        )
+
+
+def init_distributed(config: DistributedConfig | None = None) -> bool:
+    """Initialize the multi-process JAX runtime. Idempotent; returns True if
+    a multi-process runtime is (now) active, False for single-process.
+
+    Call once at program start, before the first `jax.devices()` touch.
+    Single-process (num_processes absent or 1) is a no-op so every local
+    entry point — tests, bench, serving — needs no special-casing.
+    """
+    global _INITIALIZED
+    cfg = config or DistributedConfig.from_env()
+    if _INITIALIZED:
+        return jax.process_count() > 1
+    if not cfg.coordinator_address and (cfg.num_processes or 1) == 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    _INITIALIZED = True
+    logger.info(
+        "distributed runtime: process %d/%d, %d local + %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+    return jax.process_count() > 1
+
+
+def make_global_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: list | None = None,
+) -> Mesh:
+    """Build the (hp, dp) mesh over *all* processes' devices, laying the
+    mesh out so `dp` neighbors are physically close (ICI) and `hp` spans
+    the slower outer axis.
+
+    Uses `mesh_utils.create_device_mesh`, which reorders devices by their
+    physical coordinates so the inner mesh axis maps to torus neighbors —
+    exactly what the psum'd histogram reduction wants. Falls back to the
+    simple reshape (`make_mesh`) when the topology is unknown (CPU backend,
+    virtual devices) — there the order is irrelevant anyway.
+    """
+    cfg = config or MeshConfig()
+    devs = devices if devices is not None else jax.devices()
+    n = len(devs)
+    hp = max(1, cfg.hp)
+    if n % hp:
+        raise ValueError(f"hp={hp} does not divide global device count {n}")
+    dp = n // hp if cfg.dp == -1 else cfg.dp
+    if hp * dp != n:
+        raise ValueError(f"mesh {hp}x{dp} != {n} devices")
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh((hp, dp), devices=devs)
+    except (ValueError, AssertionError, NotImplementedError):
+        # Unknown topology (virtual CPU devices, single chip): device order
+        # is irrelevant, so the plain-reshape mesh is equivalent.
+        return make_mesh(cfg, devices=devs)
+    return Mesh(arr, (cfg.axis_hp, cfg.axis_dp))
+
+
+__all__ = [
+    "DistributedConfig",
+    "init_distributed",
+    "make_global_mesh",
+    "make_mesh",
+]

@@ -1,0 +1,79 @@
+"""Multi-host distributed layer (`parallel/distributed.py`, SURVEY §5.8):
+process bootstrap is a single-host no-op, and the topology-aware global mesh
+drives the same psum-reduced training paths as the plain mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cobalt_smart_lender_ai_tpu.config import GBDTConfig, MeshConfig
+from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTHyperparams, fit_binned
+from cobalt_smart_lender_ai_tpu.ops.binning import compute_bin_edges, transform
+from cobalt_smart_lender_ai_tpu.parallel.distributed import (
+    DistributedConfig,
+    init_distributed,
+    make_global_mesh,
+)
+from cobalt_smart_lender_ai_tpu.parallel.sharded import (
+    fit_binned_dp,
+    predict_margin_dp,
+)
+
+
+def test_init_distributed_single_host_noop():
+    """With no coordinator configured this must be a no-op returning False —
+    every local entry point (tests, bench, serving) relies on that."""
+    assert init_distributed(DistributedConfig()) is False
+    assert jax.process_count() == 1  # runtime untouched
+
+
+def test_distributed_config_from_env(monkeypatch):
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "10.0.0.1:8476")
+    monkeypatch.setenv("NUM_PROCESSES", "4")
+    monkeypatch.setenv("PROCESS_ID", "2")
+    cfg = DistributedConfig.from_env()
+    assert cfg.coordinator_address == "10.0.0.1:8476"
+    assert cfg.num_processes == 4 and cfg.process_id == 2
+    monkeypatch.delenv("COORDINATOR_ADDRESS")
+    monkeypatch.delenv("NUM_PROCESSES")
+    monkeypatch.delenv("PROCESS_ID")
+    empty = DistributedConfig.from_env()
+    assert empty.coordinator_address is None and empty.num_processes is None
+
+
+def test_global_mesh_shape_and_axes():
+    mesh = make_global_mesh(MeshConfig(hp=2))
+    assert mesh.axis_names == ("hp", "dp")
+    assert mesh.devices.shape == (2, 4)
+    # every device appears exactly once
+    assert len({d.id for d in mesh.devices.flat}) == 8
+    with pytest.raises(ValueError):
+        make_global_mesh(MeshConfig(hp=3))
+
+
+def test_global_mesh_trains_identically_to_single_device():
+    """dp-sharded fit over the topology-ordered mesh must be bit-identical
+    to the unsharded fit — the device reordering must not change semantics."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(512, 12)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] + rng.logistic(size=512) * 0.5 > 0).astype(np.int32)
+    spec = compute_bin_edges(jnp.asarray(X), n_bins=16)
+    bins = transform(spec, jnp.asarray(X))
+    hp = GBDTHyperparams.from_config(
+        GBDTConfig(n_estimators=8, max_depth=3, n_bins=16, subsample=1.0)
+    )
+    kw = dict(n_trees_cap=8, depth_cap=3, n_bins=16)
+    ref = fit_binned(
+        bins, jnp.asarray(y), jnp.ones(512), jnp.ones(12, bool), hp,
+        jax.random.PRNGKey(0), **kw,
+    )
+    mesh = make_global_mesh(MeshConfig(hp=1))
+    got = fit_binned_dp(
+        mesh, bins, jnp.asarray(y), None, None, hp, jax.random.PRNGKey(0), **kw
+    )
+    np.testing.assert_array_equal(np.asarray(ref.feature), np.asarray(got.feature))
+    np.testing.assert_array_equal(np.asarray(ref.thr_bin), np.asarray(got.thr_bin))
+    m_ref = np.asarray(predict_margin_dp(mesh, got, bins, use_binned=True))
+    assert np.isfinite(m_ref).all()

@@ -112,6 +112,33 @@ def test_pandas_na_tokens_recognized():
     assert ours["s"].isna().tolist() == [False, True, True, True]
 
 
+def test_hex_and_locale_free_parsing():
+    """strtod pitfalls the reader must not have: C99 hex floats must stay
+    strings (pandas parity), while inf/nan tokens and padded/'+'-signed
+    numbers parse as floats."""
+    _native_or_skip()
+    csv = b"hex,num\n0x1A,+1\n0x2B, 2.5 \nabc,inf\n"
+    ours = native.read_csv(csv, engine="native")
+    ref = pd.read_csv(io.BytesIO(csv))
+    _assert_frames_match(ours, ref)
+    assert not pd.api.types.is_numeric_dtype(ours["hex"])
+    assert pd.api.types.is_numeric_dtype(ours["num"])
+    assert np.isinf(ours["num"].to_numpy(np.float64)[2])
+
+
+def test_quoted_empty_row_is_kept():
+    """A single-column row containing '""' is a real (missing) row, not a
+    blank line — row counts must match pandas."""
+    _native_or_skip()
+    csv = b'a\n""\n1\n'
+    ours = native.read_csv(csv, engine="native")
+    ref = pd.read_csv(io.BytesIO(csv))
+    assert len(ours) == len(ref) == 2
+    np.testing.assert_allclose(
+        ours["a"].to_numpy(np.float64), [np.nan, 1.0], equal_nan=True
+    )
+
+
 def test_short_and_long_rows_tolerated():
     _native_or_skip()
     csv = b"a,b,c\n1,x\n2,y,3,EXTRA\n"
