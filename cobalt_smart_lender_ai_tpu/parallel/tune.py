@@ -4,9 +4,14 @@ The TPU-native replacement for `RandomizedSearchCV(n_iter=20, cv=3,
 n_jobs=-1)` at `model_tree_train_test.py:148-159`: instead of a joblib
 process pool, the (candidate x fold) job axis is sharded over the ``hp`` mesh
 axis and each job's rows are sharded over ``dp``. Because every GBDT
-hyperparameter is traced (models/gbdt.py), all jobs share ONE compiled
-program — a vmap over the local job slice — so the 60-fit search is a single
-XLA dispatch instead of 60 Python-orchestrated fits.
+hyperparameter is traced (models/gbdt.py), all jobs of a dispatch share ONE
+compiled program — a vmap over the local job slice — instead of 60
+Python-orchestrated fits. `randomized_search` issues one such dispatch per
+distinct ``max_depth`` in the sampled candidates (the structural tree-tensor
+size is depth_cap-bound, so depth-bucketing keeps a depth-3 job from paying
+a depth-9 candidate's 512-leaf tensors); global candidate ids keep each
+job's RNG stream — and therefore every score — identical to a joint
+dispatch.
 
 Fold membership is expressed as per-row weights (train weight 0 on validation
 rows), keeping shapes static; validation AUC is the weighted sort-based
@@ -131,12 +136,18 @@ def cross_validate_gbdt(
     sample_weight: jax.Array | None = None,
     hp_axis: str = "hp",
     dp_axis: str = "dp",
+    cand_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Validation ROC-AUC for every (candidate, fold) job, shape ``(C, K)``.
 
     Jobs shard over the ``hp`` mesh axis (padded to a multiple of its size);
     rows shard over ``dp``. One compiled program covers every job.
     ``sample_weight`` scales both training weights and validation AUC weights.
+    ``cand_ids`` (shape ``(C,)``, defaults to ``arange(C)``) are the
+    candidates' *global* indices: each job's RNG stream is derived from
+    ``cand_id * K + fold``, so a caller dispatching candidate subsets (the
+    depth-bucketed search) reproduces the joint dispatch's subsample /
+    colsample draws — and therefore its scores — exactly.
     """
     C = jax.tree.leaves(hps)[0].shape[0]
     K, N = val_masks.shape
@@ -156,7 +167,13 @@ def cross_validate_gbdt(
     n_jobs_padded = n_jobs + (-n_jobs) % hp_size
     job_hp = jax.tree.map(lambda a: _pad_to(a, n_jobs_padded, 0), job_hp)
     job_fold = _pad_to(job_fold, n_jobs_padded, 0)
-    job_ids = jnp.arange(n_jobs_padded, dtype=jnp.int32)
+    if cand_ids is None:
+        cand_ids = jnp.arange(C, dtype=jnp.int32)
+    job_ids = jnp.repeat(cand_ids.astype(jnp.int32), K) * K + jnp.tile(
+        jnp.arange(K, dtype=jnp.int32), C
+    )
+    # Padded jobs' scores are discarded; their RNG stream is irrelevant.
+    job_ids = _pad_to(job_ids, n_jobs_padded, 0)
 
     # Row padding for the dp axis. Padding must be weight-0 on BOTH sides of
     # the fold: excluded from validation by a padded-out val mask AND from
@@ -249,22 +266,42 @@ def randomized_search(
     bins = transform(spec, X)
 
     candidates = sample_candidates(tune.param_space, tune.n_iter, tune.seed)
-    hps, n_trees_cap, depth_cap = stack_candidates(candidates, base)
-    val_masks = jnp.asarray(stratified_kfold_masks(y_np, tune.cv_folds, tune.seed))
-
-    aucs = cross_validate_gbdt(
-        mesh,
-        bins,
-        jnp.asarray(y_np),
-        hps,
-        val_masks,
-        jax.random.PRNGKey(tune.seed),
-        n_trees_cap=n_trees_cap,
-        depth_cap=depth_cap,
-        n_bins=base.n_bins,
-        feature_mask=None if feature_mask is None else jnp.asarray(feature_mask, bool),
+    val_masks = jnp.asarray(
+        stratified_kfold_masks(y_np, tune.cv_folds, tune.seed)
     )
-    mean_auc = np.asarray(aucs.mean(axis=1))
+    fm = None if feature_mask is None else jnp.asarray(feature_mask, bool)
+
+    # Bucket candidates by their resolved max_depth: the complete-tree
+    # tensors are sized by the *structural* depth_cap, so one depth-9
+    # candidate in a joint batch would force 512-leaf trees on every vmapped
+    # job. Per-bucket dispatches keep each job's tree tensor at its own
+    # depth. Scores are unchanged by bucketing: AUC is invariant to the cap
+    # (levels beyond a candidate's traced max_depth are forced trivial), and
+    # passing the candidates' *global* indices as cand_ids keeps every job's
+    # RNG stream identical to the joint dispatch's.
+    by_depth: dict[int, list[int]] = {}
+    for i, cand in enumerate(candidates):
+        by_depth.setdefault(base.replace(**dict(cand)).max_depth, []).append(i)
+    split_scores = np.zeros((len(candidates), tune.cv_folds))
+    for _, idxs in sorted(by_depth.items()):
+        hps, n_trees_cap, depth_cap = stack_candidates(
+            [candidates[i] for i in idxs], base
+        )
+        aucs = cross_validate_gbdt(
+            mesh,
+            bins,
+            jnp.asarray(y_np),
+            hps,
+            val_masks,
+            jax.random.PRNGKey(tune.seed),
+            n_trees_cap=n_trees_cap,
+            depth_cap=depth_cap,
+            n_bins=base.n_bins,
+            feature_mask=fm,
+            cand_ids=jnp.asarray(idxs, jnp.int32),
+        )
+        split_scores[idxs] = np.asarray(aucs)
+    mean_auc = split_scores.mean(axis=1)
     best_i = int(mean_auc.argmax())
     best_params = dict(candidates[best_i])
 
@@ -277,7 +314,7 @@ def randomized_search(
         cv_results_={
             "params": candidates,
             "mean_test_score": mean_auc,
-            "split_test_scores": np.asarray(aucs),
+            "split_test_scores": split_scores,
         },
     )
 

@@ -159,7 +159,14 @@ class ScorerService:
         """`POST /predict_bulk_csv` (cobalt_fast_api.py:113-126): CSV in,
         records with an appended `prob_default` column out; non-finite values
         serialized as the string "null" exactly like the reference's
-        `fillna("null")`."""
+        `fillna("null")`.
+
+        Deliberately parses with pandas, not the native reader: the echoed
+        passthrough columns must serialize with pandas' dtype inference
+        (ints stay ints) to keep the reference's exact JSON shape, and the
+        response must not depend on whether the host has a C++ toolchain.
+        Serving batches are small; the native reader's win is the
+        training-side ingest (`io.store.load_frame`)."""
         df = pd.read_csv(_io.BytesIO(csv_bytes))
         missing = [n for n in self.feature_names if n not in df.columns]
         if missing:

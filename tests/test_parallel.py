@@ -129,6 +129,63 @@ def test_cross_validate_padding_parity(small_binned):
     np.testing.assert_array_equal(np.asarray(aucs_internal), np.asarray(aucs_explicit))
 
 
+def test_bucketed_dispatch_matches_joint_dispatch(small_binned):
+    """Depth-bucketed cross_validate dispatches with global cand_ids must
+    reproduce the joint dispatch's scores exactly — including the
+    subsample/colsample RNG streams (subsample < 1 exercises them)."""
+    from cobalt_smart_lender_ai_tpu.parallel.tune import stack_candidates
+
+    bins, y, y_np = small_binned
+    mesh = make_mesh(MeshConfig(hp=1))
+    cands = [
+        {"n_estimators": 10, "max_depth": 2, "subsample": 0.7},
+        {"n_estimators": 10, "max_depth": 4, "subsample": 0.7},
+        {"n_estimators": 15, "max_depth": 2, "subsample": 0.9},
+    ]
+    base = GBDTConfig(n_bins=32)
+    masks = jnp.asarray(stratified_kfold_masks(y_np, 2, seed=0))
+    rng = jax.random.PRNGKey(3)
+
+    hps, tc, dc = stack_candidates(cands, base)
+    joint = np.asarray(
+        cross_validate_gbdt(
+            mesh, bins, y, hps, masks, rng, n_trees_cap=tc, depth_cap=dc, n_bins=32
+        )
+    )
+    bucketed = np.zeros_like(joint)
+    for idxs in ([0, 2], [1]):  # the depth buckets
+        hps_b, tc_b, dc_b = stack_candidates([cands[i] for i in idxs], base)
+        aucs = cross_validate_gbdt(
+            mesh, bins, y, hps_b, masks, rng,
+            n_trees_cap=tc_b, depth_cap=dc_b, n_bins=32,
+            cand_ids=jnp.asarray(idxs, jnp.int32),
+        )
+        bucketed[idxs] = np.asarray(aucs)
+    np.testing.assert_allclose(bucketed, joint, atol=1e-6)
+
+
+def test_cv_auc_invariant_to_depth_cap(small_binned):
+    """A candidate's CV AUC must not depend on the structural depth_cap it
+    is batched under (levels beyond its traced max_depth are forced
+    trivial) — the invariant that makes the depth-bucketed search dispatch
+    score-preserving."""
+    bins, y, y_np = small_binned
+    mesh = make_mesh(MeshConfig(hp=1))
+    hp = GBDTHyperparams.from_config(
+        GBDTConfig(n_estimators=10, max_depth=2, n_bins=32)
+    )
+    hps = jax.tree.map(lambda x: jnp.stack([x]), hp)
+    masks = jnp.asarray(stratified_kfold_masks(y_np, 2, seed=0))
+    kw = dict(n_trees_cap=10, n_bins=32)
+    a2 = cross_validate_gbdt(
+        mesh, bins, y, hps, masks, jax.random.PRNGKey(0), depth_cap=2, **kw
+    )
+    a4 = cross_validate_gbdt(
+        mesh, bins, y, hps, masks, jax.random.PRNGKey(0), depth_cap=4, **kw
+    )
+    np.testing.assert_allclose(np.asarray(a2), np.asarray(a4), atol=1e-6)
+
+
 def test_randomized_search_end_to_end(small_binned):
     _, _, y_np = small_binned
     X, y = make_classification(
@@ -148,6 +205,9 @@ def test_randomized_search_end_to_end(small_binned):
     )
     assert res.best_score_ == max(res.cv_results_["mean_test_score"])
     assert set(res.best_params_) == {"n_estimators", "max_depth"}
+    # depth-bucketed dispatch must fill every candidate's split scores
+    split = res.cv_results_["split_test_scores"]
+    assert split.shape == (4, 2) and (split > 0.5).all()
     p = np.asarray(res.best_estimator_.predict_proba(X)[:, 1])
     assert roc_auc_score(y, p) > 0.9
 
