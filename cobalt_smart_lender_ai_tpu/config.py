@@ -154,6 +154,11 @@ class ServeConfig:
 
 @dataclasses.dataclass(frozen=True)
 class PipelineConfig:
+    #: Write the cleaned / tree / nn intermediate frames to the store (the
+    #: reference persists every inter-stage CSV to S3). At full-table scale
+    #: this fetches the engineered device matrices back to host (~GB); turn
+    #: off for pure-throughput runs.
+    save_intermediate: bool = True
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     gbdt: GBDTConfig = dataclasses.field(default_factory=GBDTConfig)
     mlp: MLPConfig = dataclasses.field(default_factory=MLPConfig)

@@ -14,7 +14,8 @@ Google Drive archive. This module provides the same entry two ways:
   versioned L0 artifact exactly as they would the real table.
 
 Either way the output is the same contract: a raw CSV in the workspace plus
-a named md5 pin in the registry, which `pipeline.run_data_stages` then loads.
+a named md5 pin in the registry; `pipeline.run_pipeline` consumes it via the
+object store's ``raw_key`` (or takes the frame directly).
 """
 
 from __future__ import annotations

@@ -32,17 +32,19 @@ def split_mask(n_rows: int, test_fraction: float, seed: int) -> jax.Array:
 def train_test_split_hashed(X, y, *, test_fraction: float = 0.2, seed: int = 22):
     """Split arrays into (X_train, X_test, y_train, y_test).
 
-    Selection happens host-side once (dynamic shapes are kept out of jit);
-    everything downstream sees static shapes.
+    Only a scalar (the train count, which fixes the two static output
+    sizes) is fetched to host; the row data is partitioned **on device**
+    with a stable argsort of the mask (train rows first, each side keeping
+    its original order, identical to boolean indexing). At the 2.3M-row
+    scale this matters: a host-side split round-trips ~1.8GB through the
+    host (~150s over a tunneled TPU); the device partition is milliseconds.
     """
-    mask = np.asarray(split_mask(int(X.shape[0]), test_fraction, seed))
-    Xn, yn = np.asarray(X), np.asarray(y)
-    return (
-        jnp.asarray(Xn[~mask]),
-        jnp.asarray(Xn[mask]),
-        jnp.asarray(yn[~mask]),
-        jnp.asarray(yn[mask]),
-    )
+    mask = split_mask(int(X.shape[0]), test_fraction, seed)
+    n_train = int(X.shape[0]) - int(jnp.sum(mask))
+    order = jnp.argsort(mask, stable=True)  # False (train) first
+    Xd = jnp.take(jnp.asarray(X), order, axis=0)
+    yd = jnp.take(jnp.asarray(y), order, axis=0)
+    return Xd[:n_train], Xd[n_train:], yd[:n_train], yd[n_train:]
 
 
 def stratified_fold_ids(y: np.ndarray, n_folds: int, seed: int) -> np.ndarray:
