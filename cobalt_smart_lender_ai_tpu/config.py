@@ -103,6 +103,11 @@ class TuneConfig:
     cv_folds: int = 3
     seed: int = 22
     scoring: str = "roc_auc"
+    #: Split each fan-out dispatch into chunks of this many boosting rounds
+    #: (margins carried between dispatches; numerically identical). Needed at
+    #: full-table scale where one all-jobs x all-trees dispatch would exceed
+    #: the runtime's dispatch-duration tolerance. None = single dispatch.
+    chunk_trees: int | None = None
     # Search space: model_tree_train_test.py:139-146
     param_space: Mapping[str, Sequence[Any]] = dataclasses.field(
         default_factory=lambda: {

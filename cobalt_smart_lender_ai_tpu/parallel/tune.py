@@ -34,8 +34,7 @@ from cobalt_smart_lender_ai_tpu.config import GBDTConfig, MeshConfig, TuneConfig
 from cobalt_smart_lender_ai_tpu.models.gbdt import (
     GBDTClassifier,
     GBDTHyperparams,
-    fit_binned,
-    predict_margin,
+    fit_binned_resumable,
 )
 from cobalt_smart_lender_ai_tpu.ops.binning import compute_bin_edges, transform
 from cobalt_smart_lender_ai_tpu.ops.metrics import roc_auc
@@ -137,8 +136,14 @@ def cross_validate_gbdt(
     hp_axis: str = "hp",
     dp_axis: str = "dp",
     cand_ids: jax.Array | None = None,
+    chunk_trees: int | None = None,
 ) -> jax.Array:
     """Validation ROC-AUC for every (candidate, fold) job, shape ``(C, K)``.
+
+    ``chunk_trees`` splits the boosting rounds across multiple dispatches
+    (margins carried between them, numerically identical — see the runner
+    below); use it when n_jobs x n_trees x rows would make one dispatch run
+    longer than the environment tolerates.
 
     Jobs shard over the ``hp`` mesh axis (padded to a multiple of its size);
     rows shard over ``dp``. One compiled program covers every job.
@@ -187,53 +192,86 @@ def cross_validate_gbdt(
     val_p = _pad_to(val_masks.astype(jnp.float32).T, n_total, 0.0).T  # (K, n_total)
     w_p = _pad_to(sw, n_total, 0.0)
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(
-            P(dp_axis, None),  # bins
-            P(dp_axis),  # y
-            P(None, dp_axis),  # val masks
-            P(dp_axis),  # row weights (0 on dp padding)
-            P(hp_axis),  # job hp pytree
-            P(hp_axis),  # job fold ids
-            P(hp_axis),  # job global ids
-            P(None),  # feature mask
-            P(),  # rng
-        ),
-        out_specs=P(hp_axis, dp_axis),
-        check_vma=False,
-    )
-    def _run(bins_l, y_l, val_l, w_l, hp_l, fold_l, ids_l, fm_l, rng_l):
-        def one_job(hp_j, fold_j, id_j):
-            train_w = w_l * (1.0 - val_l[fold_j])
-            forest = fit_binned(
-                bins_l,
-                y_l,
-                train_w,
-                fm_l,
-                hp_j,
-                jax.random.fold_in(rng_l, id_j),
-                n_trees_cap=n_trees_cap,
-                depth_cap=depth_cap,
-                n_bins=n_bins,
-                axis_name=dp_axis,
-            )
-            return predict_margin(forest, bins_l, use_binned=True)
+    # Each dispatch advances every job by one chunk of boosting rounds,
+    # carrying the per-job margin — the fan-out analog of
+    # `fit_binned_chunked` (this environment kills dispatches over ~60s; a
+    # 60-job x 300-tree single dispatch at full-table scale is minutes).
+    # The carried margin over ALL rows (weight-0 validation rows are routed
+    # through every tree too) IS the forest's predict margin, so no separate
+    # predict pass is needed and chunking is bit-identical to one dispatch:
+    # tree RNG streams and the traced `n_estimators` mask both key off the
+    # global tree index via `tree_offset`.
+    def make_runner(k_trees: int):
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(
+                P(hp_axis, dp_axis),  # carried margins
+                P(),  # global tree offset
+                P(dp_axis, None),  # bins
+                P(dp_axis),  # y
+                P(None, dp_axis),  # val masks
+                P(dp_axis),  # row weights (0 on dp padding)
+                P(hp_axis),  # job hp pytree
+                P(hp_axis),  # job fold ids
+                P(hp_axis),  # job global ids
+                P(None),  # feature mask
+                P(),  # rng
+            ),
+            out_specs=P(hp_axis, dp_axis),
+            check_vma=False,
+        )
+        def _run(m_l, off_l, bins_l, y_l, val_l, w_l, hp_l, fold_l, ids_l, fm_l, rng_l):
+            def one_job(m0, hp_j, fold_j, id_j):
+                train_w = w_l * (1.0 - val_l[fold_j])
+                _, m1 = fit_binned_resumable(
+                    bins_l,
+                    y_l,
+                    train_w,
+                    fm_l,
+                    hp_j,
+                    jax.random.fold_in(rng_l, id_j),
+                    n_trees_cap=k_trees,
+                    depth_cap=depth_cap,
+                    n_bins=n_bins,
+                    axis_name=dp_axis,
+                    init_margin=m0,
+                    tree_offset=off_l,
+                )
+                return m1
 
-        return jax.vmap(one_job)(hp_l, fold_l, ids_l)  # (J_local, N_local)
+            return jax.vmap(one_job)(m_l, hp_l, fold_l, ids_l)  # (J_local, N_local)
 
-    margins = jax.jit(_run)(
-        bins_p,
-        y_p,
-        val_p,
-        w_p,
-        job_hp,
-        job_fold,
-        job_ids,
-        fm,
-        rng,
-    )  # (n_jobs_padded, n_total), sharded (hp, dp)
+        # Donate the carried margins: the caller rebinds them every chunk, so
+        # without donation each dispatch double-buffers the largest tensor in
+        # the loop (~550MB at 60 jobs x 2.3M rows).
+        return jax.jit(_run, donate_argnums=(0,))
+
+    if chunk_trees is None or chunk_trees >= n_trees_cap:
+        schedule = [(0, n_trees_cap)]
+    else:
+        schedule = [
+            (off, min(chunk_trees, n_trees_cap - off))
+            for off in range(0, n_trees_cap, chunk_trees)
+        ]
+    runners: dict[int, Any] = {}
+    margins = jnp.zeros((n_jobs_padded, n_total), jnp.float32)
+    for off, k_trees in schedule:
+        if k_trees not in runners:
+            runners[k_trees] = make_runner(k_trees)
+        margins = runners[k_trees](
+            margins,
+            jnp.int32(off),
+            bins_p,
+            y_p,
+            val_p,
+            w_p,
+            job_hp,
+            job_fold,
+            job_ids,
+            fm,
+            rng,
+        )  # (n_jobs_padded, n_total), sharded (hp, dp)
 
     @jax.jit
     def _score(margins, val_masks_f, w_f, job_fold, y_f):
@@ -299,6 +337,7 @@ def randomized_search(
             n_bins=base.n_bins,
             feature_mask=fm,
             cand_ids=jnp.asarray(idxs, jnp.int32),
+            chunk_trees=tune.chunk_trees,
         )
         split_scores[idxs] = np.asarray(aucs)
     mean_auc = split_scores.mean(axis=1)

@@ -161,11 +161,9 @@ def run_pipeline(
     est = search.best_estimator_
     margin_test = est.predict_margin(Xte_sel)
     y_test_f = jax.numpy.asarray(y_test, jax.numpy.float32)
-    test_auc = float(roc_auc(jax.numpy.asarray(y_test_f), margin_test))
-    y_pred = np.asarray(est.predict(Xte_sel))
-    report_dict = binary_classification_report(
-        jax.numpy.asarray(y_test_f), jax.numpy.asarray(y_pred)
-    )
+    test_auc = float(roc_auc(y_test_f, margin_test))
+    y_pred = est.predict(Xte_sel)
+    report_dict = binary_classification_report(y_test_f, y_pred)
     metrics = {
         # the reference's exact metrics.json schema
         # (model_tree_train_test.py:235-242)
@@ -204,11 +202,7 @@ def run_pipeline(
             from cobalt_smart_lender_ai_tpu.models.gbdt import gain_importances
             from cobalt_smart_lender_ai_tpu.ops.metrics import confusion_matrix
 
-            cm = np.asarray(
-                confusion_matrix(
-                    jax.numpy.asarray(y_test_f), jax.numpy.asarray(y_pred)
-                )
-            )
+            cm = np.asarray(confusion_matrix(y_test_f, y_pred))
             gains, _ = gain_importances(est.forest, len(selected))
             store.put_bytes(
                 key + ".confusion_matrix.png", render_confusion_matrix(cm)

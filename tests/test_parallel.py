@@ -164,6 +164,31 @@ def test_bucketed_dispatch_matches_joint_dispatch(small_binned):
     np.testing.assert_allclose(bucketed, joint, atol=1e-6)
 
 
+def test_chunked_cv_matches_single_dispatch(small_binned):
+    """Tree-chunked fan-out dispatches (margins carried between them) must be
+    numerically identical to the single joint dispatch — same RNG streams
+    via global tree offsets, same traced n_estimators mask."""
+    from cobalt_smart_lender_ai_tpu.parallel.tune import stack_candidates
+
+    bins, y, y_np = small_binned
+    mesh = make_mesh(MeshConfig(hp=2))
+    cands = [
+        {"n_estimators": 9, "max_depth": 3, "subsample": 0.8},
+        {"n_estimators": 12, "max_depth": 3, "subsample": 0.7},
+        {"n_estimators": 5, "max_depth": 2},
+    ]
+    hps, tc, dc = stack_candidates(cands, GBDTConfig(n_bins=32))
+    masks = jnp.asarray(stratified_kfold_masks(y_np, 2, seed=0))
+    kw = dict(n_trees_cap=tc, depth_cap=dc, n_bins=32)
+    one = cross_validate_gbdt(
+        mesh, bins, y, hps, masks, jax.random.PRNGKey(7), **kw
+    )
+    chunked = cross_validate_gbdt(
+        mesh, bins, y, hps, masks, jax.random.PRNGKey(7), chunk_trees=5, **kw
+    )
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(one), atol=1e-6)
+
+
 def test_cv_auc_invariant_to_depth_cap(small_binned):
     """A candidate's CV AUC must not depend on the structural depth_cap it
     is batched under (levels beyond its traced max_depth are forced
