@@ -163,6 +163,40 @@ def test_store_load_frame_uses_reader(tmp_path):
     assert out["s"].fillna("").tolist() == ["a", "", "c,d"]
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_random_frames_match_pandas(seed):
+    """Property test: random frames with adversarial cell content must parse
+    identically (values + dtypes + row count) through both engines."""
+    _native_or_skip()
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 200))
+    pieces = {}
+    nasty = [
+        "", "a,b", 'say "hi"', "line\nbreak", "NA", "null", "None", "nan",
+        "0x1F", " padded ", "+5", "-", ".", "1e", "e5", "inf", "-inf",
+        "'quote", "trail,", "日本語", "a" * 200,
+    ]
+    for j in range(int(rng.integers(1, 8))):
+        kind = rng.integers(0, 3)
+        if kind == 0:  # numeric with missing
+            col = rng.normal(size=n)
+            col[rng.random(n) < 0.3] = np.nan
+            pieces[f"num{j}"] = col
+        elif kind == 1:  # ints
+            pieces[f"int{j}"] = rng.integers(-1000, 1000, n)
+        else:  # nasty strings
+            pieces[f"str{j}"] = [
+                nasty[int(rng.integers(len(nasty)))] for _ in range(n)
+            ]
+    df = pd.DataFrame(pieces)
+    buf = io.BytesIO()
+    df.to_csv(buf, index=False)
+    data = buf.getvalue()
+    ours = native.read_csv(data, engine="native")
+    ref = pd.read_csv(io.BytesIO(data))
+    _assert_frames_match(ours, ref)
+
+
 def test_fallback_when_disabled(monkeypatch):
     """engine='auto' must work with the native reader force-disabled."""
     monkeypatch.setattr(native, "_LIB", None)

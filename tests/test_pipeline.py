@@ -86,6 +86,37 @@ def test_intermediate_frames_round_trip(pipeline_run):
     assert 0.1 < tree["loan_default"].mean() < 0.35
 
 
+def test_pipeline_on_sharded_mesh():
+    """The whole composition must also run with jobs sharded over hp=2 and
+    rows over dp=4 (the 8-virtual-device mesh) — RFE's dp-sharded refits,
+    the fan-out search, and the final fit all together."""
+    from cobalt_smart_lender_ai_tpu.data.synthetic import (
+        synthetic_lendingclub_frame,
+    )
+    from cobalt_smart_lender_ai_tpu.parallel.mesh import make_mesh
+
+    cfg = PipelineConfig(
+        save_intermediate=False,
+        gbdt=GBDTConfig(n_bins=32),
+        rfe=RFEConfig(n_select=10, step=40, n_estimators=10, max_depth=3),
+        tune=TuneConfig(
+            n_iter=2,
+            cv_folds=2,
+            chunk_trees=30,  # exercise the chunked dispatch path too
+            param_space={
+                "n_estimators": (60,),
+                "max_depth": (3,),
+                "learning_rate": (0.1,),
+            },
+        ),
+        mesh=MeshConfig(hp=2),
+    )
+    raw = synthetic_lendingclub_frame(3000, seed=9)
+    result = run_pipeline(cfg, raw=raw, mesh=make_mesh(cfg.mesh))
+    assert result.test_auc > 0.9
+    assert len(result.selected_features) == 10
+
+
 def test_plot_artifacts_emitted(pipeline_run):
     """The reference uploads confusion-matrix + feature-importance PNGs next
     to the model (model_tree_train_test.py:184-210); the pipeline must too."""
