@@ -52,6 +52,11 @@ class GBDTConfig:
     n_bins: int = 255  # quantile bins per feature; bin 0 reserved for missing
     scale_pos_weight: float = 1.0
     seed: int = 42
+    #: Boosting rounds per XLA dispatch (margins carried between dispatches,
+    #: numerically identical — models/gbdt.py `fit_binned_chunked`). Set when
+    #: a full fit would outlive the runtime's dispatch tolerance (deep trees x
+    #: millions of rows). None = single dispatch.
+    chunk_trees: int | None = None
 
     def replace(self, **kw: Any) -> "GBDTConfig":
         return dataclasses.replace(self, **kw)

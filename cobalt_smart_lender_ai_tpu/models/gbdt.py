@@ -406,12 +406,32 @@ def fit_binned_chunked(
     """Host-loop fit in chunks of ``chunk_trees`` boosting rounds per XLA
     dispatch, carrying the margin between dispatches. Numerically identical
     to `fit_binned` (same per-tree RNG streams via the global tree index);
-    needed because this environment kills dispatches running over ~60s."""
+    needed because this environment kills dispatches running over ~60s.
+
+    Every dispatch runs the SAME ``chunk_trees``-sized compiled program: a
+    ragged final chunk would compile a second program (expensive at the
+    scales this exists for), so the tail runs full-size. Its overflow tree
+    slots have global index >= n_trees_cap >= hp.n_estimators, making them
+    inert (zero leaf values / gains) — they are trimmed from the returned
+    forest so the result stays bit-identical to the unchunked fit."""
+    if chunk_trees <= 0:
+        raise ValueError(f"chunk_trees must be positive, got {chunk_trees}")
+    if chunk_trees >= n_trees_cap:
+        return fit_binned(
+            bins,
+            y,
+            sample_weight,
+            feature_mask,
+            hp,
+            rng,
+            n_trees_cap=n_trees_cap,
+            depth_cap=depth_cap,
+            n_bins=n_bins,
+        )
     N = bins.shape[0]
     margin = jnp.zeros((N,), jnp.float32)
     chunks = []
     for off in range(0, n_trees_cap, chunk_trees):
-        k = min(chunk_trees, n_trees_cap - off)
         forest_c, margin = fit_binned_resumable(
             bins,
             y,
@@ -419,23 +439,26 @@ def fit_binned_chunked(
             feature_mask,
             hp,
             rng,
-            n_trees_cap=k,
+            n_trees_cap=chunk_trees,
             depth_cap=depth_cap,
             n_bins=n_bins,
             init_margin=margin,
             tree_offset=jnp.int32(off),
         )
         chunks.append(forest_c)
-    if len(chunks) == 1:
-        return chunks[0]
+    # Trim tail-padding trees so the forest matches the unchunked fit
+    # exactly. (The padded slots are inert for predictions either way —
+    # global tree index >= hp.n_estimators zeroes their leaf values.)
     return Forest(
-        feature=jnp.concatenate([c.feature for c in chunks]),
-        thr_bin=jnp.concatenate([c.thr_bin for c in chunks]),
-        thr_float=jnp.concatenate([c.thr_float for c in chunks]),
-        missing_left=jnp.concatenate([c.missing_left for c in chunks]),
-        gain=jnp.concatenate([c.gain for c in chunks]),
-        cover=jnp.concatenate([c.cover for c in chunks]),
-        leaf_value=jnp.concatenate([c.leaf_value for c in chunks]),
+        feature=jnp.concatenate([c.feature for c in chunks])[:n_trees_cap],
+        thr_bin=jnp.concatenate([c.thr_bin for c in chunks])[:n_trees_cap],
+        thr_float=jnp.concatenate([c.thr_float for c in chunks])[:n_trees_cap],
+        missing_left=jnp.concatenate([c.missing_left for c in chunks])[
+            :n_trees_cap
+        ],
+        gain=jnp.concatenate([c.gain for c in chunks])[:n_trees_cap],
+        cover=jnp.concatenate([c.cover for c in chunks])[:n_trees_cap],
+        leaf_value=jnp.concatenate([c.leaf_value for c in chunks])[:n_trees_cap],
         depth=depth_cap,
     )
 
@@ -538,17 +561,19 @@ class GBDTClassifier:
             if feature_mask is None
             else jnp.asarray(feature_mask, bool)
         )
-        forest = fit_binned(
-            bins,
-            y,
-            sw,
-            fm,
-            GBDTHyperparams.from_config(cfg),
-            jax.random.PRNGKey(cfg.seed),
+        kw = dict(
             n_trees_cap=cfg.n_estimators,
             depth_cap=cfg.max_depth,
             n_bins=cfg.n_bins,
         )
+        hp = GBDTHyperparams.from_config(cfg)
+        key = jax.random.PRNGKey(cfg.seed)
+        if cfg.chunk_trees is not None:
+            forest = fit_binned_chunked(
+                bins, y, sw, fm, hp, key, chunk_trees=cfg.chunk_trees, **kw
+            )
+        else:
+            forest = fit_binned(bins, y, sw, fm, hp, key, **kw)
         self.forest = attach_float_thresholds(forest, self.bin_spec)
         return self
 

@@ -149,3 +149,22 @@ def test_gain_importances_rank_signal_over_noise():
     assert imp[:2].sum() > 0.8
     total_gain, n_splits = gain_importances(model.forest, 6)
     assert float(n_splits.sum()) > 0
+
+
+def test_chunked_classifier_fit_is_identical():
+    """GBDTConfig.chunk_trees splits the fit across dispatches without
+    changing a single bit of the model (global tree offsets preserve RNG
+    streams and the n_estimators mask)."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(1500, 10)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.int32)
+    a = GBDTClassifier(n_estimators=30, max_depth=3, n_bins=32, subsample=0.8).fit(X, y)
+    b = GBDTClassifier(
+        n_estimators=30, max_depth=3, n_bins=32, subsample=0.8, chunk_trees=7
+    ).fit(X, y)
+    np.testing.assert_array_equal(
+        np.asarray(a.predict_margin(X)), np.asarray(b.predict_margin(X))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.forest.feature), np.asarray(b.forest.feature)
+    )
