@@ -33,7 +33,11 @@ import jax
 import numpy as np
 import pandas as pd
 
-from cobalt_smart_lender_ai_tpu.config import PipelineConfig
+from cobalt_smart_lender_ai_tpu.config import (
+    PipelineConfig,
+    RFEConfig,
+    TuneConfig,
+)
 from cobalt_smart_lender_ai_tpu.data.clean import clean_raw_frame
 from cobalt_smart_lender_ai_tpu.data.features import (
     drop_training_leakage,
@@ -240,11 +244,35 @@ def main(argv=None) -> PipelineResult:
         help="generate a synthetic raw table instead of loading raw_key",
     )
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--quick",
+        action="store_true",
+        help="slim search/RFE profile (4x2 search, RFE step 20) — minutes "
+        "instead of the reference's full 20x3 protocol, for demos and smoke "
+        "runs; quality lands in the same AUC regime",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s [%(levelname)s] %(message)s"
     )
+    cfg = PipelineConfig()
+    if args.quick:
+        cfg = dataclasses.replace(
+            cfg,
+            rfe=RFEConfig(n_select=20, step=20, n_estimators=20, max_depth=3),
+            tune=TuneConfig(
+                n_iter=4,
+                cv_folds=2,
+                chunk_trees=100,
+                param_space={
+                    "n_estimators": (150, 300),
+                    "max_depth": (3,),
+                    "learning_rate": (0.05, 0.1),
+                    "subsample": (0.8,),
+                },
+            ),
+        )
     raw = None
     if args.synthetic_rows:
         from cobalt_smart_lender_ai_tpu.data.synthetic import (
@@ -253,7 +281,7 @@ def main(argv=None) -> PipelineResult:
 
         raw = synthetic_lendingclub_frame(args.synthetic_rows, seed=args.seed)
     store = ObjectStore(args.store) if args.store else None
-    result = run_pipeline(raw=raw, store=store)
+    result = run_pipeline(cfg, raw=raw, store=store)
     print(
         {
             "test_auc": result.test_auc,
