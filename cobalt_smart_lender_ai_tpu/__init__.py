@@ -10,7 +10,7 @@ FastAPI LendingClub loan-default pipeline):
                  binning, gradient histograms (MXU-matmul formulation on TPU,
                  segment-sum on CPU).
 - ``models``   — histogram GBDT (the XGBoost-equivalent), logistic regression,
-                 Flax MLP, FT-Transformer.
+                 Flax MLP, FT-Transformer, TabNet.
 - ``parallel`` — device-mesh construction, CV x hyperparameter fan-out via
                  vmap/shard_map over ICI, RFE feature selection.
 - ``explain``  — exact TreeSHAP over tree tensors, gain importances.

@@ -1,5 +1,5 @@
 """Model families: histogram GBDT (XGBoost-equivalent), logistic regression,
-Flax MLP challenger, FT-Transformer."""
+Flax MLP challenger, FT-Transformer, TabNet."""
 
 from cobalt_smart_lender_ai_tpu.models.gbdt import (
     Forest,
@@ -16,10 +16,18 @@ from cobalt_smart_lender_ai_tpu.models.ft_transformer import (
 )
 from cobalt_smart_lender_ai_tpu.models.linear import LogisticRegression
 from cobalt_smart_lender_ai_tpu.models.nn import MLP, MLPClassifier
+from cobalt_smart_lender_ai_tpu.models.tabnet import (
+    TabNet,
+    TabNetClassifier,
+    TabNetConfig,
+)
 
 __all__ = [
     "MLP",
     "MLPClassifier",
+    "TabNet",
+    "TabNetClassifier",
+    "TabNetConfig",
     "FTTransformer",
     "FTTransformerClassifier",
     "Forest",

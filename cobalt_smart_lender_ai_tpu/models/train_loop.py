@@ -81,7 +81,7 @@ def make_optimizer(s: TrainSettings) -> optax.GradientTransformation:
 
 
 def fit_binary(
-    apply_fn: Callable[..., jax.Array],  # (params, X_batch, rngs) -> logits
+    apply_fn: Callable[..., Any],  # (params, X_batch, rngs) -> logits | (logits, aux)
     params,
     X: Batch,
     y: jax.Array,
@@ -94,7 +94,10 @@ def fit_binary(
 ):
     """Train to convergence/early stop; returns (best_params, history).
 
-    ``apply_fn(params, X_batch, rngs=...)`` must return logits. When a
+    ``apply_fn(params, X_batch, rngs=...)`` returns logits, or a 2-tuple
+    ``(logits, aux)`` where ``aux`` is an auxiliary loss term — a per-row
+    ``(B,)`` vector (weighted like the BCE, so padding rows are inert;
+    TabNet's sparsity regularizer rides this) or a plain scalar. When a
     validation set is given, early stopping tracks its ROC-AUC and the best
     epoch's params are restored (Keras `restore_best_weights` semantics).
     """
@@ -123,9 +126,21 @@ def fit_binary(
 
     def loss_fn(p, xb, yb, wb, rng):
         rngs = {"dropout": rng} if uses_dropout else None
-        logits = apply_fn(p, xb, rngs=rngs)
+        out = apply_fn(p, xb, rngs=rngs)
+        # apply_fn may return (logits, aux) — e.g. TabNet's sparsity
+        # regularizer — or bare logits. A per-row (B,) aux is weighted like
+        # the BCE so zero-weight padding rows stay inert; a scalar aux is
+        # added as-is (caller takes responsibility for padding).
+        logits, aux = out if isinstance(out, tuple) else (out, 0.0)
+        aux = jnp.asarray(aux, jnp.float32)
+        if aux.ndim == 1:
+            aux = jnp.sum(wb * aux) / jnp.maximum(jnp.sum(wb), 1e-6)
         bce = optax.sigmoid_binary_cross_entropy(logits, yb)
-        return jnp.sum(wb * bce) / jnp.maximum(jnp.sum(wb), 1e-6) + s.l2 * _l2_penalty(p)
+        return (
+            jnp.sum(wb * bce) / jnp.maximum(jnp.sum(wb), 1e-6)
+            + s.l2 * _l2_penalty(p)
+            + aux
+        )
 
     @jax.jit
     def train_epoch(p, opt_state, rng):
@@ -151,7 +166,8 @@ def fit_binary(
 
     @jax.jit
     def val_auc_fn(p):
-        logits = apply_fn(p, X_val, rngs=None)
+        out = apply_fn(p, X_val, rngs=None)
+        logits = out[0] if isinstance(out, tuple) else out
         return roc_auc(jnp.asarray(y_val, jnp.float32), logits)
 
     rng = jax.random.PRNGKey(s.seed)
