@@ -57,10 +57,10 @@ def _fit(X, y, sample_weight, l2, pos_weight, n_iter: int):
 
 
 @jax.jit
-def _predict_proba(params: LogisticRegressionParams, X):
+def _decision_function(params: LogisticRegressionParams, X):
     Xf = jnp.where(jnp.isnan(X), params.mean[None, :], X)
     Xs = (Xf - params.mean[None, :]) / params.scale[None, :]
-    return jax.nn.sigmoid(Xs @ params.coef + params.intercept)
+    return Xs @ params.coef + params.intercept
 
 
 class LogisticRegression:
@@ -79,9 +79,16 @@ class LogisticRegression:
         self.params = _fit(X, y, sw, jnp.float32(self.l2), jnp.float32(self.pos_weight), self.n_iter)
         return self
 
-    def predict_proba(self, X) -> jax.Array:
+    def decision_function(self, X) -> jax.Array:
+        """(N,) logits — sklearn's `decision_function`."""
         assert self.params is not None, "fit first"
-        return _predict_proba(self.params, jnp.asarray(X, jnp.float32))
+        return _decision_function(self.params, jnp.asarray(X, jnp.float32))
+
+    def predict_proba(self, X) -> jax.Array:
+        """(N, 2) class probabilities, matching sklearn and the other model
+        facades (GBDT/MLP/FT-Transformer/TabNet)."""
+        p1 = jax.nn.sigmoid(self.decision_function(X))
+        return jnp.stack([1.0 - p1, p1], axis=1)
 
     def predict(self, X, threshold: float = 0.5) -> jax.Array:
-        return (self.predict_proba(X) >= threshold).astype(jnp.int32)
+        return (self.predict_proba(X)[:, 1] >= threshold).astype(jnp.int32)

@@ -13,7 +13,7 @@ def test_logreg_separable():
     X = rng.normal(0, 1, (500, 4)).astype(np.float32)
     y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
     model = LogisticRegression(l2=1e-3).fit(X, y)
-    auc = roc_auc_score(y, np.asarray(model.predict_proba(X)))
+    auc = roc_auc_score(y, np.asarray(model.predict_proba(X)[:, 1]))
     assert auc > 0.99
 
 
@@ -25,7 +25,7 @@ def test_logreg_close_to_sklearn():
     y = (rng.random(n) < 1 / (1 + np.exp(-(X @ beta)))).astype(np.float32)
     ours = LogisticRegression(l2=1.0).fit(X, y)
     sk = SkLogReg(C=1.0, max_iter=500).fit((X - X.mean(0)) / X.std(0), y)
-    auc_ours = roc_auc_score(y, np.asarray(ours.predict_proba(X)))
+    auc_ours = roc_auc_score(y, np.asarray(ours.predict_proba(X)[:, 1]))
     auc_sk = roc_auc_score(y, sk.predict_proba((X - X.mean(0)) / X.std(0))[:, 1])
     assert abs(auc_ours - auc_sk) < 0.005
 
@@ -37,8 +37,10 @@ def test_logreg_handles_nan_and_pos_weight():
     y = (np.nan_to_num(X[:, 0]) > 0.8).astype(np.float32)  # ~20% positive
     model = LogisticRegression(l2=0.1, pos_weight=4.0).fit(X, y)
     proba = np.asarray(model.predict_proba(X))
+    assert proba.shape == (len(y), 2)
     assert np.isfinite(proba).all()
-    auc = roc_auc_score(y, proba)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    auc = roc_auc_score(y, proba[:, 1])
     assert auc > 0.85
 
 
@@ -46,6 +48,6 @@ def test_end_to_end_slice_on_pipeline(train_test):
     X_tr, X_te, y_tr, y_te, names = train_test
     pos = y_tr.mean()
     model = LogisticRegression(l2=1.0, pos_weight=float((1 - pos) / pos)).fit(X_tr, y_tr)
-    auc = roc_auc_score(y_te, np.asarray(model.predict_proba(X_te)))
+    auc = roc_auc_score(y_te, np.asarray(model.predict_proba(X_te)[:, 1]))
     # linear model on engineered features: decent but below tree-model regime
     assert auc > 0.75
