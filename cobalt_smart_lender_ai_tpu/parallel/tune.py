@@ -250,16 +250,22 @@ def cross_validate_gbdt(
     if chunk_trees is None or chunk_trees >= n_trees_cap:
         schedule = [(0, n_trees_cap)]
     else:
+        # Every dispatch runs a FULL chunk, tail included (the tail-padding
+        # design of fit_binned_chunked, models/gbdt.py:411-416): overflow
+        # trees have global index >= n_trees_cap >= every job's traced
+        # n_estimators, so the tree_on mask zeroes their leaf values and the
+        # carried margins are unchanged — while only one shard_map program
+        # ever compiles. A ragged tail would compile a second program
+        # (40-400s on this hardware) to save a few inert trees of compute.
         schedule = [
-            (off, min(chunk_trees, n_trees_cap - off))
-            for off in range(0, n_trees_cap, chunk_trees)
+            (off, chunk_trees) for off in range(0, n_trees_cap, chunk_trees)
         ]
-    runners: dict[int, Any] = {}
+    # Every schedule entry has the same chunk size, so exactly one program
+    # compiles.
+    runner = make_runner(schedule[0][1])
     margins = jnp.zeros((n_jobs_padded, n_total), jnp.float32)
-    for off, k_trees in schedule:
-        if k_trees not in runners:
-            runners[k_trees] = make_runner(k_trees)
-        margins = runners[k_trees](
+    for off, _k_trees in schedule:
+        margins = runner(
             margins,
             jnp.int32(off),
             bins_p,

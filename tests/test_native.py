@@ -197,6 +197,32 @@ def test_fuzz_random_frames_match_pandas(seed):
     _assert_frames_match(ours, ref)
 
 
+def test_no_pyarrow_fallback_matches_pandas(monkeypatch):
+    """The no-pyarrow branch of `_read_native` (str-list Series) must produce
+    the same frame as the Arrow zero-copy path — pyarrow is installed in CI,
+    so without this monkeypatch that branch never runs."""
+    _native_or_skip()
+    import sys
+
+    csv = (
+        b"a,b c,d\n"
+        b'1,"hello, world",x\n'
+        b'2,"quote "" inside",\n'  # empty string cell -> missing
+        b"3,plain,y\n"
+    )
+    # None in sys.modules makes `import pyarrow` raise ImportError. Scope the
+    # patch to the parse only: pandas itself lazily imports pyarrow when the
+    # assertions below touch arrow-backed str columns.
+    with monkeypatch.context() as m:
+        m.setitem(sys.modules, "pyarrow", None)
+        ours = native.read_csv(csv, engine="native")
+    ref = pd.read_csv(io.BytesIO(csv))
+    _assert_frames_match(ours, ref)
+    # Empty cells mean missing in BOTH branches (the divergence the Arrow
+    # path encodes with if_else(equal(arr, ""), None, arr)).
+    assert ours["d"].isna().tolist() == [False, True, False]
+
+
 def test_fallback_when_disabled(monkeypatch):
     """engine='auto' must work with the native reader force-disabled."""
     monkeypatch.setattr(native, "_LIB", None)
