@@ -99,6 +99,26 @@ def test_nan_rows_explained(small_model):
     assert abs(float(base) + float(np.asarray(phis).sum()) - margin) < 1e-4
 
 
+def test_depth9_exact_and_bounded():
+    """The shipped search space's corner (config.py max_depth up to 9): the
+    polynomial algorithm must stay exact AND bounded there — the old subset
+    enumeration needed 512 * 512 * 9 intermediates per row per tree and could
+    not serve a tuned depth-9 artifact."""
+    X, y = make_classification(
+        n_samples=600, n_features=6, n_informative=4, random_state=1
+    )
+    X = X.astype(np.float32)
+    model = GBDTClassifier(n_estimators=8, max_depth=9, n_bins=16).fit(X, y)
+    assert model.forest.depth == 9
+    phis, base = shap_values(model.forest, jnp.asarray(X[:20]), n_features=6)
+    margins = np.asarray(model.predict_margin(X[:20]))
+    np.testing.assert_allclose(
+        float(base) + np.asarray(phis).sum(axis=1), margins, atol=1e-3
+    )
+    bf = _brute_force_phi(model.forest, X[3], 6, 8)
+    np.testing.assert_allclose(np.asarray(phis)[3], bf, atol=1e-3)
+
+
 def test_explainer_facade(small_model):
     model, X = small_model
     ex = TreeExplainer(model)
