@@ -160,6 +160,13 @@ class ServeConfig:
     port: int = 8000
     model_key: str = "models/gbdt/model_tree"
     history_dir: str = "data/3-outputs/history"
+    #: Bulk scoring pads each request to a power-of-two row bucket and chunks
+    #: anything larger than ``max_batch_rows``, bounding the service's
+    #: lifetime XLA-compile count at log2(max_batch_rows) programs instead of
+    #: one per distinct CSV length (each compile is tens of seconds on a cold
+    #: backend). ``precompile_batch_buckets`` are warmed at startup.
+    max_batch_rows: int = 4096
+    precompile_batch_buckets: tuple[int, ...] = (256,)
 
 
 @dataclasses.dataclass(frozen=True)

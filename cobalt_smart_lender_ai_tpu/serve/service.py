@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import io as _io
 import math
+import threading
 from typing import Any, Mapping
 
 import jax
@@ -93,11 +94,13 @@ class ScorerService:
     """Restored model + pre-compiled scorer behind the three endpoints of
     `cobalt_fast_api.py:96-143`."""
 
-    def __init__(self, artifact: GBDTArtifact):
+    def __init__(self, artifact: GBDTArtifact, config: ServeConfig | None = None):
         self.artifact = artifact
+        self.config = config or ServeConfig()
         self.feature_names = list(artifact.feature_names)
         self._n_features = len(self.feature_names)
         forest = artifact.forest
+        self._forest = forest
         # Pre-compile both device programs at startup (the reference builds
         # its TreeExplainer in the lifespan hook for the same reason).
         self._margin_fn = jax.jit(lambda X: predict_margin(forest, X)).lower(
@@ -106,10 +109,51 @@ class ScorerService:
         self._shap_fn = jax.jit(
             lambda X: shap_values(forest, X, n_features=self._n_features)
         ).lower(jax.ShapeDtypeStruct((1, self._n_features), jnp.float32)).compile()
-        # Batch scoring keeps a cached jit per distinct batch shape.
-        self._batch_margin = jax.jit(lambda X: predict_margin(forest, X))
+        # Batch scoring pads every request to a power-of-two row bucket, so
+        # the compile count is bounded by log2(max_batch_rows) over the
+        # service's whole lifetime — NOT one XLA compile (tens of seconds on
+        # a cold backend) per distinct CSV length. Each bucket's program is
+        # AOT-compiled once and cached; `precompile_batch_buckets` warms the
+        # common bulk path at startup alongside the single-row programs.
+        self._bucket_lock = threading.Lock()
+        self._bucket_fns: dict[int, Any] = {1: self._margin_fn}  # (1, F) reuse
+        for b in self.config.precompile_batch_buckets:
+            self._margin_for_bucket(self._bucket_of(b))
         total_gain, _ = gain_importances(forest, self._n_features)
         self._gain = np.asarray(total_gain)
+
+    def _bucket_of(self, n: int) -> int:
+        """Smallest power-of-two >= n, capped at max_batch_rows (larger
+        requests are chunked)."""
+        return min(1 << max(0, n - 1).bit_length(), self.config.max_batch_rows)
+
+    def _margin_for_bucket(self, bucket: int):
+        fn = self._bucket_fns.get(bucket)
+        if fn is None:
+            # Lock: the stdlib adapter is a ThreadingHTTPServer; without it,
+            # two concurrent first hits on a bucket would each pay the
+            # multi-second compile.
+            with self._bucket_lock:
+                fn = self._bucket_fns.get(bucket)
+                if fn is None:
+                    forest = self._forest
+                    fn = (
+                        jax.jit(lambda X: predict_margin(forest, X))
+                        .lower(
+                            jax.ShapeDtypeStruct(
+                                (bucket, self._n_features), jnp.float32
+                            )
+                        )
+                        .compile()
+                    )
+                    self._bucket_fns[bucket] = fn
+        return fn
+
+    @property
+    def compiled_batch_buckets(self) -> tuple[int, ...]:
+        """Row buckets with a live compiled program — observable so tests can
+        assert a second, differently-sized batch does NOT recompile."""
+        return tuple(sorted(self._bucket_fns))
 
     @classmethod
     def from_store(
@@ -118,7 +162,7 @@ class ScorerService:
         """Startup restore — the lifespan S3 download + joblib.load of
         `cobalt_fast_api.py:42-47`."""
         cfg = config or ServeConfig()
-        return cls(GBDTArtifact.load(store, cfg.model_key))
+        return cls(GBDTArtifact.load(store, cfg.model_key), cfg)
 
     # -- scoring helpers ------------------------------------------------------
 
@@ -131,9 +175,24 @@ class ScorerService:
 
     def predict_proba(self, X: np.ndarray) -> np.ndarray:
         """P(default) for an (N, F) float array — `predict_proba_df`
-        (cobalt_fast_api.py:90-91)."""
-        margin = self._batch_margin(jnp.asarray(X, jnp.float32))
-        return np.asarray(jax.nn.sigmoid(margin))
+        (cobalt_fast_api.py:90-91). Rows are chunked to ``max_batch_rows``
+        and each chunk zero-padded to its power-of-two bucket, so any
+        request sequence hits at most log2(max_batch_rows) compiles."""
+        X = np.asarray(X, dtype=np.float32)
+        N = X.shape[0]
+        out = np.empty((N,), dtype=np.float32)
+        step = self.config.max_batch_rows
+        for start in range(0, N, step):
+            chunk = X[start : start + step]
+            n = chunk.shape[0]
+            bucket = self._bucket_of(n)
+            if n < bucket:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((bucket - n, X.shape[1]), np.float32)]
+                )
+            margin = self._margin_for_bucket(bucket)(jnp.asarray(chunk))
+            out[start : start + n] = np.asarray(jax.nn.sigmoid(margin))[:n]
+        return out
 
     # -- endpoint handlers ----------------------------------------------------
 

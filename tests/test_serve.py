@@ -153,6 +153,37 @@ def test_feature_importance_bulk_empty_rejected(service):
         service.feature_importance_bulk({"data": []})
 
 
+def test_bulk_scoring_shape_buckets(serving_artifact):
+    """Bulk scoring must pad to power-of-two row buckets: a second,
+    differently-sized batch that lands in an already-compiled bucket must NOT
+    compile a new program (each compile is tens of seconds on a cold
+    backend), oversize requests chunk at max_batch_rows, and padding/chunking
+    must not change any row's probability."""
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+
+    store, X = serving_artifact
+    svc = ScorerService.from_store(
+        store, ServeConfig(max_batch_rows=64, precompile_batch_buckets=(8,))
+    )
+    assert svc.compiled_batch_buckets == (1, 8)  # (1,F) reuse + warmed
+    p5 = svc.predict_proba(X[:5])
+    assert svc.compiled_batch_buckets == (1, 8)  # 5 -> bucket 8: cache hit
+    p7 = svc.predict_proba(X[:7])
+    assert svc.compiled_batch_buckets == (1, 8)  # second size, same bucket
+    p9 = svc.predict_proba(X[:9])  # -> bucket 16: exactly one new program
+    assert svc.compiled_batch_buckets == (1, 8, 16)
+    p150 = svc.predict_proba(X[:150])  # 64 + 64 + 22 -> buckets 64 and 32
+    assert svc.compiled_batch_buckets == (1, 8, 16, 32, 64)
+    svc.predict_proba(X[:150])
+    svc.predict_proba(X[:40])
+    assert svc.compiled_batch_buckets == (1, 8, 16, 32, 64)  # lifetime-bounded
+    # Padding rows and chunking must be invisible in the outputs.
+    np.testing.assert_allclose(p7[:5], p5, atol=1e-6)
+    np.testing.assert_allclose(p150[:9], p9, atol=1e-6)
+    np.testing.assert_allclose(p150[:5], p5, atol=1e-6)
+    assert p150.shape == (150,)
+
+
 # --- stdlib HTTP adapter end-to-end ------------------------------------------
 
 
