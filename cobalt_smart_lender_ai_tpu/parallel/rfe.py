@@ -7,6 +7,20 @@ loop #1 (~123 sequential XGBoost fits). TPU-first difference (SURVEY hard part
 every refit reuses one compiled XLA program with static shapes — zero
 recompiles across the whole elimination schedule — and each refit's rows can
 shard over the ``dp`` mesh axis.
+
+``cv_folds`` adds the reference's exploration-path RFECV
+(`RFECV(min_features_to_select=20, step=5, cv=3, scoring='roc_auc')`,
+notebooks/04_model_training.ipynb cell 13): each elimination step's surviving
+mask is scored by k-fold validation AUC through the `cross_validate_gbdt`
+fan-out (folds ride the ``hp`` mesh axis; one compiled program scores every
+step), and the returned support is the *best-scoring* feature count, not
+necessarily ``n_select``. Like the importance refits, the scoring masks are
+data, so the whole CV-RFE schedule compiles exactly two programs (selector
+fit + fold scorer). Design divergence from sklearn, declared: sklearn RFECV
+runs an independent elimination per fold and re-runs plain RFE at the winning
+count; here one elimination (full-data importances, the production RFE path)
+is scored per step on held-out folds — same model-selection signal, k x fewer
+fits, and no per-fold mask divergence to reconcile.
 """
 
 from __future__ import annotations
@@ -18,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from cobalt_smart_lender_ai_tpu.config import GBDTConfig, RFEConfig
+from cobalt_smart_lender_ai_tpu.config import GBDTConfig, MeshConfig, RFEConfig
 from cobalt_smart_lender_ai_tpu.models.gbdt import (
     GBDTHyperparams,
     fit_binned,
@@ -37,6 +51,9 @@ class RFEResult:
     #: convention for any ``step``.
     ranking_: np.ndarray
     n_features_: int
+    #: CV-RFE only: mean validation AUC per surviving feature count, keyed by
+    #: n_features — sklearn RFECV's ``cv_results_`` equivalent.
+    cv_scores_: dict[int, float] | None = None
 
 
 def rfe_select(
@@ -46,10 +63,13 @@ def rfe_select(
     *,
     mesh: Mesh | None = None,
     dp_axis: str = "dp",
+    cv_folds: int | None = None,
 ) -> RFEResult:
     """Eliminate to exactly ``config.n_select`` features by repeatedly
     refitting a light selector GBDT and dropping the ``step``
-    lowest-total-gain surviving features."""
+    lowest-total-gain surviving features. With ``cv_folds`` set, every
+    surviving mask (including the initial full set) is scored by k-fold
+    validation AUC and the best-scoring count >= ``n_select`` wins."""
     cfg = config or RFEConfig()
     X = jnp.asarray(X, jnp.float32)
     y = jnp.asarray(y)
@@ -68,12 +88,55 @@ def rfe_select(
     rng = jax.random.PRNGKey(cfg.seed)
     sw = jnp.ones((N,), jnp.float32)
 
+    score_mask = None
+    cv_scores: dict[int, float] | None = None
+    cv_masks: dict[int, np.ndarray] = {}
+    if cv_folds:
+        # Fold scorer: ONE candidate (the selector's own hyperparams) x
+        # k folds through the fan-out machinery; masks are traced data, so
+        # every elimination step reuses this single compiled program.
+        from cobalt_smart_lender_ai_tpu.parallel.tune import (
+            cross_validate_gbdt,
+            stratified_kfold_masks,
+        )
+
+        if mesh is None:
+            from cobalt_smart_lender_ai_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(MeshConfig())
+        val_masks = jnp.asarray(
+            stratified_kfold_masks(np.asarray(y), cv_folds, cfg.seed)
+        )
+        hp_stacked = jax.tree.map(lambda a: jnp.stack([a]), hp)
+        cv_rng = jax.random.PRNGKey(cfg.seed + 1)
+        cv_scores = {}
+
+        def score_mask(fm: np.ndarray) -> None:
+            aucs = cross_validate_gbdt(
+                mesh,
+                bins,
+                y,
+                hp_stacked,
+                val_masks,
+                cv_rng,
+                n_trees_cap=cfg.n_estimators,
+                depth_cap=cfg.max_depth,
+                n_bins=n_bins,
+                feature_mask=jnp.asarray(fm),
+                dp_axis=dp_axis,
+            )
+            n = int(fm.sum())
+            cv_scores[n] = float(np.asarray(aucs).mean())
+            cv_masks[n] = fm.copy()
+
     mask = np.ones(F, dtype=bool)
     ranking = np.ones(F, dtype=np.int64)
     n_iters = max(0, -(-(F - cfg.n_select) // cfg.step))
     next_rank = n_iters + 1  # first iteration's drops get the worst rank
     it = 0
     while mask.sum() > cfg.n_select:
+        if score_mask is not None:
+            score_mask(mask)
         fm = jnp.asarray(mask)
         if mesh is not None:
             forest = fit_binned_dp(
@@ -110,4 +173,25 @@ def rfe_select(
         ranking[drop] = next_rank
         next_rank -= 1
         it += 1
-    return RFEResult(support_=mask, ranking_=ranking, n_features_=int(mask.sum()))
+    if score_mask is not None:
+        score_mask(mask)  # the final n_select-feature mask
+        # Best mean val AUC wins; ties prefer fewer features (sklearn RFECV's
+        # scan order over ascending feature counts).
+        best_n = min(cv_scores, key=lambda n: (-cv_scores[n], n))
+        mask = cv_masks[best_n]
+        # Re-base ranking_ on the winning mask so 'ranking_ == 1' still means
+        # selected (sklearn reruns RFE to the chosen count; rewinding the
+        # recorded elimination is equivalent): re-included features drop to
+        # rank 1 and the remaining eliminated ranks close ranks to 2..K.
+        new_ranking = np.ones(F, dtype=np.int64)
+        elim_ranks = np.unique(ranking[~mask])
+        rank_map = {int(r): i + 2 for i, r in enumerate(np.sort(elim_ranks))}
+        for f in np.flatnonzero(~mask):
+            new_ranking[f] = rank_map[int(ranking[f])]
+        ranking = new_ranking
+    return RFEResult(
+        support_=mask,
+        ranking_=ranking,
+        n_features_=int(mask.sum()),
+        cv_scores_=cv_scores,
+    )

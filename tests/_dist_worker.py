@@ -1,0 +1,66 @@
+"""Worker for the real multi-process bootstrap test (test_distributed.py).
+
+Launched twice by the parent test with COORDINATOR_ADDRESS / NUM_PROCESSES /
+PROCESS_ID in the environment — the exact env contract `DistributedConfig.
+from_env` reads on a TPU pod — on the CPU backend. Executes the real
+`jax.distributed.initialize` path (parallel/distributed.py:80-84), builds the
+global (hp, dp) mesh over both processes' devices, and psums a per-process
+value across them; the parent asserts both ranks print the full-mesh sum.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # a sitecustomize may pre-import jax
+
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from cobalt_smart_lender_ai_tpu.config import MeshConfig  # noqa: E402
+from cobalt_smart_lender_ai_tpu.parallel.distributed import (  # noqa: E402
+    init_distributed,
+    make_global_mesh,
+)
+
+
+def main() -> None:
+    active = init_distributed()  # config comes from the env, as on a pod
+    assert active, "expected a multi-process runtime"
+    assert jax.process_count() == 2, jax.process_count()
+    rank = jax.process_index()
+    n = jax.device_count()
+    assert n >= 2 and jax.local_device_count() < n
+
+    mesh = make_global_mesh(MeshConfig(hp=1, dp=n))
+    sharding = NamedSharding(mesh, P(None, "dp"))
+    local = np.full(
+        (1, jax.local_device_count()), float(rank + 1), dtype=np.float32
+    )
+    arr = jax.make_array_from_process_local_data(sharding, local, (1, n))
+
+    from functools import partial
+
+    @jax.jit
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=P(None, "dp"), out_specs=P(None, "dp")
+    )
+    def total(x):
+        return jax.numpy.broadcast_to(jax.lax.psum(x.sum(), "dp"), x.shape)
+
+    out = total(arr)
+    # Every shard must hold sum over ranks of (rank+1) * local_device_count.
+    expect = sum(
+        (r + 1) * (n // jax.process_count()) for r in range(jax.process_count())
+    )
+    got = float(np.asarray(out.addressable_shards[0].data)[0, 0])
+    assert got == expect, (got, expect)
+    print(f"RANK{rank}_PSUM_OK={got}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

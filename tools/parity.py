@@ -1,0 +1,294 @@
+"""Protocol-scale parity head-to-head: our TPU GBDT vs a CPU sklearn oracle.
+
+Runs the FULL reference training protocol twice on identical data
+(`/root/reference/src/model_train_test/model_tree_train_test.py:111-179`):
+
+    clean -> engineer -> leakage drop -> hashed 80/20 split
+    -> RFE to exactly 20 features (step 1)
+    -> 20-candidate x 3-fold randomized search
+    -> refit best, test ROC-AUC
+
+Side "ours" is this framework end to end (rfe_select + randomized_search on
+the accelerator). Side "oracle" is scikit-learn's
+`HistGradientBoostingClassifier` — the strongest gradient-boosting oracle
+available offline (the reference's XGBoost is not in the image) — driven
+through the SAME protocol on the SAME matrices and the SAME stratified fold
+masks (`stratified_kfold_masks`, seed 22, exactly what `randomized_search`
+uses internally). The oracle's search space maps the reference's XGBoost
+space onto HGB analogs (n_estimators->max_iter, colsample_bytree->
+max_features, gamma->l2_regularization; XGB's row `subsample` has no HGB
+analog and is dropped). Oracle RFE mirrors the reference's
+`RFE(estimator, step=1)` using permutation importance on a training
+subsample (HGB exposes no impurity/gain importances).
+
+Usage (two processes so the oracle never touches the accelerator):
+
+    python tools/parity.py ours   --rows 130000 --out PARITY_ours.json
+    JAX_PLATFORMS=cpu python tools/parity.py oracle --rows 130000 \
+        --out PARITY_oracle.json
+    python tools/parity.py merge PARITY_ours.json PARITY_oracle.json \
+        --out PARITY.json
+
+The merge gates ``ours.test_auc >= oracle.test_auc - 0.005`` — the round-3
+parity criterion. tests/test_parity.py runs the same head-to-head slow-marked
+and gates the committed PARITY.json on every CI run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import time
+
+import numpy as np
+
+#: The reference's RandomizedSearchCV space mapped onto
+#: HistGradientBoostingClassifier parameters (model_tree_train_test.py:139-146).
+HGB_SPACE = {
+    "max_iter": [100, 200, 300],
+    "max_depth": [3, 5, 7, 9],
+    "learning_rate": [0.01, 0.05, 0.1],
+    "max_features": [0.5, 0.8, 1.0],
+    "l2_regularization": [0.0, 1.0, 5.0],
+}
+
+PARITY_MARGIN = 0.005  # ours must be within this of the oracle (or better)
+
+
+def build_matrices(n_rows: int, seed: int):
+    """Shared data side of the protocol: synthetic raw frame -> clean ->
+    engineer -> leakage drop -> hashed split. Deterministic in (n_rows, seed),
+    so the two processes reconstruct bit-identical matrices."""
+    import jax.numpy as jnp
+
+    from cobalt_smart_lender_ai_tpu.data import (
+        clean_raw_frame,
+        engineer_features,
+        prepare_cleaned_frame,
+        synthetic_lendingclub_frame,
+        train_test_split_hashed,
+    )
+    from cobalt_smart_lender_ai_tpu.data.features import drop_training_leakage
+
+    raw = synthetic_lendingclub_frame(n_rows=n_rows, seed=seed)
+    cleaned, _ = clean_raw_frame(raw)
+    tree_ff, _, _ = engineer_features(prepare_cleaned_frame(cleaned))
+    ff = drop_training_leakage(tree_ff)
+    X_train, X_test, y_train, y_test = train_test_split_hashed(ff.X, ff.y)
+    n_pos = float(jnp.sum(y_train))
+    spw = (float(X_train.shape[0]) - n_pos) / max(n_pos, 1.0)
+    return {
+        "X_train": X_train,
+        "X_test": X_test,
+        "y_train": y_train,
+        "y_test": y_test,
+        "feature_names": list(ff.feature_names),
+        "spw": spw,
+    }
+
+
+def run_ours(mats, chunk_trees: int | None = 50) -> dict:
+    """This framework's protocol on the shared matrices — the L3 block of
+    pipeline.run_pipeline, run directly so both sides consume the same
+    arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    from cobalt_smart_lender_ai_tpu.config import (
+        GBDTConfig,
+        MeshConfig,
+        RFEConfig,
+        TuneConfig,
+    )
+    from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
+    from cobalt_smart_lender_ai_tpu.ops.metrics import roc_auc
+    from cobalt_smart_lender_ai_tpu.parallel.mesh import make_mesh
+    from cobalt_smart_lender_ai_tpu.parallel.rfe import rfe_select
+    from cobalt_smart_lender_ai_tpu.parallel.tune import randomized_search
+
+    t0 = time.time()
+    mesh = make_mesh(MeshConfig())
+    spw = mats["spw"]
+    rfe_cfg = dataclasses.replace(RFEConfig(), scale_pos_weight=spw)
+    rfe = rfe_select(mats["X_train"], mats["y_train"], rfe_cfg, mesh=mesh)
+    t_rfe = time.time() - t0
+    selected = [
+        n for n, keep in zip(mats["feature_names"], rfe.support_) if keep
+    ]
+
+    sel_idx = jnp.asarray(np.flatnonzero(rfe.support_))
+    Xtr = jnp.take(jnp.asarray(mats["X_train"]), sel_idx, axis=1)
+    Xte = jnp.take(jnp.asarray(mats["X_test"]), sel_idx, axis=1)
+    base = GBDTConfig().replace(scale_pos_weight=spw)
+    tune = dataclasses.replace(TuneConfig(), chunk_trees=chunk_trees)
+    t1 = time.time()
+    search = randomized_search(Xtr, mats["y_train"], base, tune, mesh)
+    t_search = time.time() - t1
+
+    est: GBDTClassifier = search.best_estimator_
+    margin = est.predict_margin(Xte)
+    test_auc = float(
+        roc_auc(jnp.asarray(mats["y_test"], jnp.float32), margin)
+    )
+    return {
+        "side": "ours",
+        "backend": jax.devices()[0].platform,
+        "selected_features": selected,
+        "best_params": search.best_params_,
+        "cv_auc": float(search.best_score_),
+        "test_auc": test_auc,
+        "seconds": {
+            "rfe": round(t_rfe, 1),
+            "search": round(t_search, 1),
+            "total": round(time.time() - t0, 1),
+        },
+    }
+
+
+def run_oracle(mats, seed: int = 22) -> dict:
+    """The CPU oracle: sklearn HistGradientBoostingClassifier through the
+    same RFE-20(step 1) -> 20x3 search -> test eval protocol on the same
+    matrices and fold masks."""
+    from sklearn.ensemble import HistGradientBoostingClassifier
+    from sklearn.inspection import permutation_importance
+    from sklearn.metrics import roc_auc_score
+
+    from cobalt_smart_lender_ai_tpu.parallel.tune import (
+        sample_candidates,
+        stratified_kfold_masks,
+    )
+
+    X_train = np.asarray(mats["X_train"], dtype=np.float64)
+    X_test = np.asarray(mats["X_test"], dtype=np.float64)
+    y_train = np.asarray(mats["y_train"])
+    y_test = np.asarray(mats["y_test"])
+    spw = mats["spw"]
+    sw = np.where(y_train == 1, spw, 1.0)  # scale_pos_weight analog
+    F = X_train.shape[1]
+
+    t0 = time.time()
+    # --- RFE to exactly 20, step 1 (model_tree_train_test.py:111-121).
+    # Selector matches our RFEConfig (50 rounds, depth 6, class-weighted);
+    # ranking signal is permutation importance on a 10k training subsample
+    # (HGB has no native importances).
+    rng = np.random.default_rng(42)
+    sub = rng.choice(len(y_train), size=min(10_000, len(y_train)), replace=False)
+    mask = np.ones(F, dtype=bool)
+    while mask.sum() > 20:
+        sel = HistGradientBoostingClassifier(
+            max_iter=50, max_depth=6, random_state=42
+        )
+        sel.fit(X_train[:, mask], y_train, sample_weight=sw)
+        imp = permutation_importance(
+            sel,
+            X_train[sub][:, mask],
+            y_train[sub],
+            scoring="roc_auc",
+            n_repeats=1,
+            random_state=0,
+        ).importances_mean
+        drop_local = int(np.argsort(imp, kind="stable")[0])
+        mask[np.flatnonzero(mask)[drop_local]] = False
+    t_rfe = time.time() - t0
+    selected = [n for n, keep in zip(mats["feature_names"], mask) if keep]
+
+    Xtr = X_train[:, mask]
+    Xte = X_test[:, mask]
+
+    # --- 20-candidate x 3-fold randomized search on the SAME folds ours uses.
+    candidates = sample_candidates(HGB_SPACE, 20, seed)
+    val_masks = stratified_kfold_masks(y_train, 3, seed)
+    t1 = time.time()
+    scores = np.zeros((len(candidates), 3))
+    for ci, cand in enumerate(candidates):
+        for fi in range(3):
+            val = val_masks[fi]
+            m = HistGradientBoostingClassifier(random_state=78, **cand)
+            m.fit(Xtr[~val], y_train[~val], sample_weight=sw[~val])
+            p = m.predict_proba(Xtr[val])[:, 1]
+            scores[ci, fi] = roc_auc_score(y_train[val], p)
+    mean_scores = scores.mean(axis=1)
+    best_i = int(mean_scores.argmax())
+    best = dict(candidates[best_i])
+    t_search = time.time() - t1
+
+    final = HistGradientBoostingClassifier(random_state=78, **best)
+    final.fit(Xtr, y_train, sample_weight=sw)
+    test_auc = float(roc_auc_score(y_test, final.predict_proba(Xte)[:, 1]))
+    return {
+        "side": "oracle",
+        "backend": "cpu/sklearn-HistGradientBoostingClassifier",
+        "selected_features": selected,
+        "best_params": best,
+        "cv_auc": float(mean_scores[best_i]),
+        "test_auc": test_auc,
+        "seconds": {
+            "rfe": round(t_rfe, 1),
+            "search": round(t_search, 1),
+            "total": round(time.time() - t0, 1),
+        },
+    }
+
+
+def run_head_to_head(n_rows: int, seed: int = 11, chunk_trees: int | None = 50):
+    """Both sides in one process (used by the slow-marked test, where the
+    conftest pins everything to the virtual CPU mesh)."""
+    mats = build_matrices(n_rows, seed)
+    ours = run_ours(mats, chunk_trees=chunk_trees)
+    oracle = run_oracle(mats)
+    return merge(ours, oracle, n_rows=n_rows, seed=seed)
+
+
+def merge(ours: dict, oracle: dict, **meta) -> dict:
+    gap = ours["test_auc"] - oracle["test_auc"]
+    return {
+        "protocol": "clean->engineer->RFE-20(step1)->search(20x3)->test eval "
+        "(model_tree_train_test.py:111-179)",
+        **meta,
+        "ours": ours,
+        "oracle": oracle,
+        "auc_gap_ours_minus_oracle": round(gap, 5),
+        "parity_margin": PARITY_MARGIN,
+        "parity_ok": bool(gap >= -PARITY_MARGIN),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("side", choices=["ours", "oracle", "both", "merge"])
+    ap.add_argument("inputs", nargs="*", help="json files for merge")
+    ap.add_argument("--rows", type=int, default=130_000)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--chunk-trees", type=int, default=50)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    if args.side == "merge":
+        loaded = [json.load(open(p)) for p in args.inputs]
+        by_side = {d.get("side"): d for d in loaded}
+        if set(by_side) != {"ours", "oracle"}:
+            raise SystemExit(
+                f"merge needs one 'ours' and one 'oracle' file, got sides "
+                f"{[d.get('side') for d in loaded]}"
+            )
+        result = merge(by_side["ours"], by_side["oracle"])
+    elif args.side == "both":
+        result = run_head_to_head(args.rows, args.seed, args.chunk_trees)
+    else:
+        mats = build_matrices(args.rows, args.seed)
+        result = (
+            run_ours(mats, chunk_trees=args.chunk_trees)
+            if args.side == "ours"
+            else run_oracle(mats)
+        )
+        result.update(n_rows=args.rows, seed=args.seed)
+    print(json.dumps(result, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+    return result
+
+
+if __name__ == "__main__":
+    main()
