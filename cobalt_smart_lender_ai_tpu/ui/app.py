@@ -80,7 +80,18 @@ def main() -> None:
         uploaded = st.file_uploader("Upload CSV with required columns", type="csv")
         if uploaded and st.button("Run Bulk Prediction"):
             try:
-                records = client.predict_bulk_csv(uploaded.name, uploaded.getvalue())
+                st.session_state["bulk_results"] = client.predict_bulk_csv(
+                    uploaded.name, uploaded.getvalue()
+                )
+            except Exception as e:
+                st.session_state.pop("bulk_results", None)
+                st.error(f"Prediction failed: {e}")
+        # Results live in session_state so the explorer's widgets survive
+        # Streamlit's rerun-on-interaction (the button is only True on the
+        # run it was clicked).
+        records = st.session_state.get("bulk_results")
+        if records is not None:
+            try:
                 df_result = core.coerce_results_frame(records)
                 st.subheader("Prediction Results")
                 st.dataframe(df_result)
@@ -98,8 +109,38 @@ def main() -> None:
                 ax.set_xlabel("Importance (gain)")
                 ax.set_title("Top 10 Important Features")
                 st.pyplot(fig)
+
+                # Per-row SHAP explorer — the reference notebook's row-slider
+                # force plots (04_model_training.ipynb cells 25-26), served
+                # live: pick a row, re-post it to /predict, waterfall it.
+                if len(df_result):
+                    st.subheader("Per-row SHAP Explorer")
+                    row_idx = int(
+                        st.number_input(
+                            "Row to explain",
+                            min_value=0,
+                            max_value=len(df_result) - 1,
+                            value=0,
+                            step=1,
+                        )
+                    )
+                    try:
+                        row_resp = client.predict(
+                            core.results_row_payload(df_result, row_idx)
+                        )
+                        st.caption(
+                            f"Row {row_idx}: estimated default probability "
+                            f"{row_resp['prob_default']:.2%}"
+                        )
+                        wf = core.build_waterfall(row_resp, max_display=10)
+                        fig, ax = plt.subplots(figsize=(10, 6))
+                        core.render_waterfall(ax, wf)
+                        plt.tight_layout()
+                        st.pyplot(fig)
+                    except Exception as e:
+                        st.info(f"Row explanation unavailable: {e}")
             except Exception as e:
-                st.error(f"Prediction or Feature Importance failed: {e}")
+                st.error(f"Rendering results failed: {e}")
 
 
 if __name__ == "__main__":

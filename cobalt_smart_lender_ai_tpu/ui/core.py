@@ -169,6 +169,35 @@ def coerce_results_frame(records: Sequence[Mapping[str, Any]]) -> pd.DataFrame:
     return df.apply(pd.to_numeric, errors="coerce")
 
 
+def results_row_payload(df: pd.DataFrame, idx: int) -> dict[str, float]:
+    """Rebuild a /predict request body from row ``idx`` of the bulk results
+    frame — the data step behind the per-row SHAP explorer (the reference
+    notebook's ipywidgets row slider over force plots,
+    notebooks/04_model_training.ipynb cells 25-26, surfaced in the bulk UI).
+
+    The bulk CSV already carries the canonical (aliased) feature names, so
+    the payload is just the 20 contract columns of that row; int-typed
+    indicator fields are rounded back from the frame's float coercion."""
+    if not 0 <= idx < len(df):
+        raise ValueError(f"row {idx} out of range (0..{len(df) - 1})")
+    row = df.iloc[idx]
+    payload: dict[str, float] = {}
+    missing = []
+    for name in schema.SERVING_FEATURES:
+        v = row.get(name)
+        if v is None or pd.isna(v):
+            missing.append(name)
+            continue
+        payload[name] = (
+            int(round(float(v)))
+            if name in schema.SERVING_INT_FEATURES
+            else float(v)
+        )
+    if missing:
+        raise ValueError(f"bulk frame lacks features for row {idx}: {missing}")
+    return payload
+
+
 def importance_series(top_features: Sequence[Mapping[str, Any]]) -> pd.Series:
     """`/feature_importance_bulk` response → Series for the barh chart
     (cobalt_streamlit.py:163-170), highest importance first."""

@@ -318,6 +318,30 @@ TRAINING = [
      "fig, ax = plt.subplots(figsize=(6, 3))\n"
      "ax.barh([selected[i] for i in order][::-1], np.asarray(phis)[0][order][::-1])\n"
      "ax.set_title('Top SHAP contributions, row 0'); plt.tight_layout(); plt.show()"),
+    ("md", "## Multi-row SHAP explorer\n\nThe reference explores per-row "
+     "explanations with an ipywidgets slider over force plots (its cells "
+     "25-26). Same capability: SHAP for a whole batch in one device call, "
+     "an `explain_row(i)` renderer, wired to `ipywidgets.interact` when "
+     "available (offline executions render a sample of rows statically)."),
+    ("code",
+     "from cobalt_smart_lender_ai_tpu.ui.core import build_waterfall, render_waterfall\n"
+     "n_explore = 20\n"
+     "phis_b, base_b = shap_values(est.forest, jnp.asarray(Xte_sel[:n_explore]), n_features=len(sel))\n"
+     "phis_b = np.asarray(phis_b)\n"
+     "def explain_row(i=0):\n"
+     "    resp = {'shap_values': phis_b[i].tolist(), 'base_value': float(base_b),\n"
+     "            'features': selected,\n"
+     "            'input_row': {n: float(v) for n, v in zip(selected, Xte_sel[i])}}\n"
+     "    fig, ax = plt.subplots(figsize=(8, 4))\n"
+     "    render_waterfall(ax, build_waterfall(resp, max_display=10))\n"
+     "    ax.set_title(f'row {i}: margin {float(base_b) + phis_b[i].sum():+.3f}')\n"
+     "    plt.tight_layout(); plt.show()\n"
+     "try:\n"
+     "    from ipywidgets import interact\n"
+     "    interact(explain_row, i=(0, n_explore - 1))\n"
+     "except ImportError:  # offline execution: render a sample statically\n"
+     "    for i in (0, 7, 13):\n"
+     "        explain_row(i)"),
     ("md", "## MLP challenger\n\nFlax MLP (128/32/16) + optax AdamW with "
      "exponential LR decay and early stopping — the reference's Keras "
      "challenger, with its dead `val_precision` monitor fixed and "

@@ -51,6 +51,31 @@ def engineered(raw_frame):
 
 
 @pytest.fixture(scope="session")
+def serving_artifact(tmp_path_factory, engineered):
+    """Train a model on exactly the 20-feature serving contract and persist
+    it, as `model_tree_train_test.py:215-230` does. Session-scoped: shared by
+    the serving, smoke, and fastapi-stub test modules."""
+    from cobalt_smart_lender_ai_tpu.data import schema
+    from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+    from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
+
+    tree_ff, _, _ = engineered
+    missing = [n for n in schema.SERVING_FEATURES if n not in tree_ff.feature_names]
+    assert not missing, f"synthetic frame lacks serving features: {missing}"
+    ff = tree_ff.select(schema.SERVING_FEATURES)
+    model = GBDTClassifier(n_estimators=25, max_depth=3, n_bins=64)
+    model.fit(np.asarray(ff.X), np.asarray(ff.y))
+    store = ObjectStore(str(tmp_path_factory.mktemp("serve") / "lake"))
+    art = GBDTArtifact(
+        forest=model.forest,
+        bin_spec=model.bin_spec,
+        feature_names=tuple(schema.SERVING_FEATURES),
+    )
+    art.save(store, "models/gbdt/model_tree")
+    return store, np.asarray(ff.X)
+
+
+@pytest.fixture(scope="session")
 def train_test(engineered):
     """Leakage-dropped tree matrix split into train/test numpy arrays."""
     from cobalt_smart_lender_ai_tpu.data.features import drop_training_leakage

@@ -162,3 +162,104 @@ def test_unsupported_future_format_rejected(store):
     blob = _pack({}, {"kind": "gbdt", "format_version": 99, "feature_names": []})
     with pytest.raises(ValueError, match="newer"):
         GBDTArtifact.from_bytes(blob)
+
+
+# --- s3 backend against a stubbed boto3 ---------------------------------------
+
+
+class _FakeS3Client:
+    """In-memory bucket honoring the exact boto3 surface _S3Store touches."""
+
+    class _ClientError(Exception):
+        pass
+
+    def __init__(self):
+        self.objects: dict[tuple[str, str], bytes] = {}
+        self.exceptions = type("Exc", (), {"ClientError": self._ClientError})
+
+    def put_object(self, Bucket, Key, Body):
+        self.objects[(Bucket, Key)] = bytes(Body)
+
+    def get_object(self, Bucket, Key):
+        import io as _io
+
+        if (Bucket, Key) not in self.objects:
+            raise self._ClientError(f"NoSuchKey: {Key}")
+        return {"Body": _io.BytesIO(self.objects[(Bucket, Key)])}
+
+    def head_object(self, Bucket, Key):
+        if (Bucket, Key) not in self.objects:
+            raise self._ClientError(f"404: {Key}")
+        return {"ContentLength": len(self.objects[(Bucket, Key)])}
+
+    def delete_object(self, Bucket, Key):
+        self.objects.pop((Bucket, Key), None)
+
+    def get_paginator(self, op):
+        assert op == "list_objects_v2"
+        objects = self.objects
+
+        class _Pager:
+            def paginate(self, Bucket, Prefix=""):
+                keys = sorted(
+                    k for (b, k) in objects if b == Bucket and k.startswith(Prefix)
+                )
+                yield {"Contents": [{"Key": k} for k in keys]}
+
+        return _Pager()
+
+
+@pytest.fixture()
+def s3_store(monkeypatch):
+    """ObjectStore('s3://...') wired to the in-memory client: the real
+    _S3Store code paths (prefix joining, pagination, error mapping) execute;
+    only the AWS wire is faked."""
+    import types as _types
+
+    fake = _FakeS3Client()
+    boto3 = _types.ModuleType("boto3")
+    boto3.client = lambda name: fake if name == "s3" else None
+    monkeypatch.setitem(sys.modules, "boto3", boto3)
+    return ObjectStore("s3://bucket/pre/fix"), fake
+
+
+def test_s3_bytes_json_roundtrip(s3_store):
+    store, fake = s3_store
+    store.put_bytes("a/b.bin", b"\x00tpu")
+    assert ("bucket", "pre/fix/a/b.bin") in fake.objects  # prefix joined
+    assert store.get_bytes("a/b.bin") == b"\x00tpu"
+    assert store.exists("a/b.bin") and not store.exists("a/nope")
+    store.put_json("meta.json", {"auc": 0.9})
+    assert store.get_json("meta.json") == {"auc": 0.9}
+    store.delete("a/b.bin")
+    assert not store.exists("a/b.bin")
+
+
+def test_s3_list_strips_prefix(s3_store):
+    store, _ = s3_store
+    for k in ("m/a.npz", "m/b.npz", "other/c.txt"):
+        store.put_bytes(k, b"x")
+    assert list(store.list("m/")) == ["m/a.npz", "m/b.npz"]
+    assert list(store.list()) == ["m/a.npz", "m/b.npz", "other/c.txt"]
+
+
+def test_s3_frame_and_artifact_roundtrip(s3_store, trained_gbdt):
+    store, _ = s3_store
+    df = pd.DataFrame({"a": [1.0, 2.0], "b": ["x", "y"]})
+    store.save_frame("frames/f.csv", df)
+    back = store.load_frame("frames/f.csv")
+    assert back["a"].tolist() == [1.0, 2.0] and back["b"].tolist() == ["x", "y"]
+    model, _, names = trained_gbdt
+    GBDTArtifact(
+        forest=model.forest, bin_spec=model.bin_spec, feature_names=tuple(names)
+    ).save(store, "m/s3model")
+    art = GBDTArtifact.load(store, "m/s3model")
+    np.testing.assert_array_equal(
+        np.asarray(art.forest.leaf_value), np.asarray(model.forest.leaf_value)
+    )
+
+
+def test_s3_without_boto3_raises(monkeypatch):
+    monkeypatch.setitem(sys.modules, "boto3", None)
+    with pytest.raises(ImportError, match="boto3"):
+        ObjectStore("s3://bucket/x")

@@ -11,7 +11,6 @@ import numpy as np
 import pytest
 
 from cobalt_smart_lender_ai_tpu.data import schema
-from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
 from cobalt_smart_lender_ai_tpu.serve import (
     ScorerService,
     ValidationError,
@@ -19,26 +18,7 @@ from cobalt_smart_lender_ai_tpu.serve import (
 )
 
 
-@pytest.fixture(scope="module")
-def serving_artifact(tmp_path_factory, engineered):
-    """Train a model on exactly the 20-feature serving contract and persist
-    it, as `model_tree_train_test.py:215-230` does."""
-    from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
-
-    tree_ff, _, _ = engineered
-    missing = [n for n in schema.SERVING_FEATURES if n not in tree_ff.feature_names]
-    assert not missing, f"synthetic frame lacks serving features: {missing}"
-    ff = tree_ff.select(schema.SERVING_FEATURES)
-    model = GBDTClassifier(n_estimators=25, max_depth=3, n_bins=64)
-    model.fit(np.asarray(ff.X), np.asarray(ff.y))
-    store = ObjectStore(str(tmp_path_factory.mktemp("serve") / "lake"))
-    art = GBDTArtifact(
-        forest=model.forest,
-        bin_spec=model.bin_spec,
-        feature_names=tuple(schema.SERVING_FEATURES),
-    )
-    art.save(store, "models/gbdt/model_tree")
-    return store, np.asarray(ff.X)
+# serving_artifact lives in conftest.py (shared with the fastapi stub tests)
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,242 @@
+"""Coverage for the FastAPI adapter without fastapi in the image.
+
+fastapi cannot be installed offline, so the Dockerfile's serving path
+(`serve/http_fastapi.py`) is exercised two ways:
+
+- an AST contract test pins the pydantic `SingleInput` schema (field names,
+  int/float types, the two space-containing aliases) to the canonical
+  contract in `data/schema.py` — the drift the reference's pydantic model
+  guards against;
+- a stub-execution test installs minimal `fastapi`/`pydantic` stand-ins and
+  runs `create_app` plus every route handler and the lifespan restore, so
+  all adapter logic (dump-by-alias, error->status mapping, upload reading)
+  executes in CI. Pydantic's own validation engine is NOT re-tested here;
+  `test_serve.py::test_fastapi_adapter_if_available` covers it wherever the
+  real fastapi exists.
+"""
+
+import ast
+import asyncio
+import sys
+import types
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.serve.service import SINGLE_INPUT_FIELDS
+
+ADAPTER = (
+    Path(__file__).resolve().parent.parent
+    / "cobalt_smart_lender_ai_tpu"
+    / "serve"
+    / "http_fastapi.py"
+)
+
+
+def _single_input_classdef() -> ast.ClassDef:
+    tree = ast.parse(ADAPTER.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "SingleInput":
+            return node
+    raise AssertionError("SingleInput class not found in http_fastapi.py")
+
+
+def test_fastapi_schema_matches_serving_contract():
+    """The pydantic model must carry exactly the 20 contract fields with the
+    reference's int/float typing and the two aliased names."""
+    cls = _single_input_classdef()
+    fields: dict[str, str] = {}
+    aliases: dict[str, str] = {}
+    for stmt in cls.body:
+        if not isinstance(stmt, ast.AnnAssign) or not isinstance(
+            stmt.target, ast.Name
+        ):
+            continue
+        name = stmt.target.id
+        if name == "model_config":
+            continue
+        fields[name] = ast.unparse(stmt.annotation)
+        if (
+            isinstance(stmt.value, ast.Call)
+            and getattr(stmt.value.func, "id", "") == "Field"
+        ):
+            for kw in stmt.value.keywords:
+                if kw.arg == "alias":
+                    # alias=schema.SERVING_FIELD_ALIASES[...] — resolve it
+                    aliases[name] = eval(  # noqa: S307 - our own source
+                        compile(ast.Expression(kw.value), "<alias>", "eval"),
+                        {"schema": schema},
+                    )
+    assert set(fields) == set(SINGLE_INPUT_FIELDS), (
+        set(fields) ^ set(SINGLE_INPUT_FIELDS)
+    )
+    for name, ann in fields.items():
+        want = "int" if SINGLE_INPUT_FIELDS[name] in schema.SERVING_INT_FEATURES else "float"
+        assert ann == want or ann.startswith(want), (name, ann)
+    assert aliases == schema.SERVING_FIELD_ALIASES
+
+
+# --- minimal fastapi/pydantic stand-ins ---------------------------------------
+
+
+class _HTTPException(Exception):
+    def __init__(self, status_code, detail=""):
+        self.status_code = status_code
+        self.detail = detail
+
+
+class _FieldInfo:
+    def __init__(self, alias=None):
+        self.alias = alias
+
+
+def _Field(alias=None):
+    return _FieldInfo(alias=alias)
+
+
+class _BaseModel:
+    """Stores constructor kwargs keyed by field name; model_dump(by_alias)
+    re-keys through the class's _FieldInfo aliases, like pydantic."""
+
+    def __init__(self, **kw):
+        self._data = kw
+
+    def __init_subclass__(cls):
+        cls._aliases = {
+            k: v.alias
+            for k, v in vars(cls).items()
+            if isinstance(v, _FieldInfo) and v.alias
+        }
+
+    def model_dump(self, by_alias=False):
+        if not by_alias:
+            return dict(self._data)
+        al = getattr(type(self), "_aliases", {})
+        return {al.get(k, k): v for k, v in self._data.items()}
+
+
+class _FastAPI:
+    def __init__(self, title="", lifespan=None):
+        self.title = title
+        self.lifespan = lifespan
+        self.routes: dict[str, object] = {}
+
+    def post(self, path):
+        def deco(fn):
+            self.routes[path] = fn
+            return fn
+
+        return deco
+
+
+class _UploadFile:
+    def __init__(self, data: bytes):
+        self._data = data
+
+    async def read(self) -> bytes:
+        return self._data
+
+
+@pytest.fixture
+def fastapi_stubbed(monkeypatch):
+    fastapi_mod = types.ModuleType("fastapi")
+    fastapi_mod.FastAPI = _FastAPI
+    fastapi_mod.HTTPException = _HTTPException
+    fastapi_mod.UploadFile = _UploadFile
+    fastapi_mod.File = lambda *a, **k: None
+    pydantic_mod = types.ModuleType("pydantic")
+    pydantic_mod.BaseModel = _BaseModel
+    pydantic_mod.ConfigDict = dict
+    pydantic_mod.Field = _Field
+    monkeypatch.setitem(sys.modules, "fastapi", fastapi_mod)
+    monkeypatch.setitem(sys.modules, "pydantic", pydantic_mod)
+    return fastapi_mod
+
+
+def _payload_by_field_name() -> dict:
+    vals = {}
+    for field, canonical in SINGLE_INPUT_FIELDS.items():
+        vals[field] = 1 if canonical in schema.SERVING_INT_FEATURES else 1.5
+    return vals
+
+
+def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
+    """Every route handler and the error mapping run against a real service."""
+    from cobalt_smart_lender_ai_tpu.serve.http_fastapi import create_app
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, X = serving_artifact
+    svc = ScorerService.from_store(store)
+    app = create_app(service=svc)
+    assert set(app.routes) == {
+        "/predict",
+        "/predict_bulk_csv",
+        "/feature_importance_bulk",
+    }
+
+    # /predict happy path: the handler only needs model_dump(by_alias=True),
+    # so a stand-in with the contract's two aliases drives it; the REAL
+    # SingleInput's field/alias fidelity is pinned by the AST contract test
+    # above (the class itself is local to create_app and, with PEP 563
+    # annotations, never escapes into the handler closure).
+    predict = app.routes["/predict"]
+
+    class SingleStub(_BaseModel):
+        application_type_Joint_App = _FieldInfo(
+            alias=schema.SERVING_FIELD_ALIASES["application_type_Joint_App"]
+        )
+        hardship_status_No_Hardship = _FieldInfo(
+            alias=schema.SERVING_FIELD_ALIASES["hardship_status_No_Hardship"]
+        )
+
+    resp = predict(SingleStub(**_payload_by_field_name()))
+    assert 0.0 <= resp["prob_default"] <= 1.0
+    assert len(resp["shap_values"]) == 20
+
+    # /predict_bulk_csv: async upload read + CSV scoring.
+    import pandas as pd
+
+    df = pd.DataFrame(X[:4], columns=list(schema.SERVING_FEATURES))
+    up = _UploadFile(df.to_csv(index=False).encode())
+    bulk = asyncio.run(app.routes["/predict_bulk_csv"](file=up))
+    assert len(bulk["predictions"]) == 4
+
+    # /predict_bulk_csv error path -> 422, not a crash.
+    with pytest.raises(_HTTPException) as ei:
+        asyncio.run(app.routes["/predict_bulk_csv"](file=_UploadFile(b"loan_amnt\n1\n")))
+    assert ei.value.status_code == 422
+
+    # /feature_importance_bulk happy + empty-data 400.
+    class BulkStub(_BaseModel):
+        pass
+
+    top = app.routes["/feature_importance_bulk"](BulkStub(data=[{"a": 1.0}]))
+    assert top["top_features"]
+    with pytest.raises(_HTTPException) as ei:
+        app.routes["/feature_importance_bulk"](BulkStub(data=[]))
+    assert ei.value.status_code == 400
+
+
+def test_fastapi_lifespan_restores_from_store(fastapi_stubbed, serving_artifact):
+    """create_app(store_uri=...) must restore the model inside the lifespan
+    hook exactly like the reference's startup S3 download."""
+    from cobalt_smart_lender_ai_tpu.serve.http_fastapi import create_app
+
+    store, X = serving_artifact
+    app = create_app(store_uri=store.uri)
+
+    async def drive():
+        async with app.lifespan(app):
+            row = np.asarray(X[:1], dtype=np.float32)
+            # the service exists only after lifespan ran
+            return app  # closure state is internal; routes prove it below
+
+    asyncio.run(drive())
+    # after lifespan, the /feature_importance_bulk route must serve
+    class BulkStub(_BaseModel):
+        pass
+
+    resp = app.routes["/feature_importance_bulk"](BulkStub(data=[{"x": 1}]))
+    assert resp["top_features"]

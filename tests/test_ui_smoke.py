@@ -1,0 +1,190 @@
+"""Headless render smoke for the Streamlit shell (`ui/app.py`).
+
+streamlit cannot be installed in this offline image (so neither can its
+`streamlit.testing.v1.AppTest`); instead a minimal scriptable stand-in is
+injected as `sys.modules['streamlit']` and `ui.app.main()` runs for real —
+every widget call, both sidebar modes, the live HTTP round-trip to a real
+`ScorerService` behind the stdlib server, matplotlib figure rendering, and
+the per-row SHAP explorer. What is NOT covered here is streamlit's own
+rerun/session-state machinery; `ui/core.py` keeps all data logic out of it
+by design (and `test_ui.py` unit-tests that layer directly).
+"""
+
+import sys
+import threading
+import types
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cobalt_smart_lender_ai_tpu.data import schema
+
+
+class _Sidebar:
+    def __init__(self, app):
+        self.app = app
+
+    def radio(self, label, options):
+        self.app.calls.append(("sidebar.radio", label))
+        return self.app.script["mode"]
+
+
+class _Column:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class _FakeStreamlit(types.ModuleType):
+    """Records every widget call; returns scripted values for inputs."""
+
+    def __init__(self, script):
+        super().__init__("streamlit")
+        self.script = script
+        self.calls: list[tuple] = []
+        self.errors: list[str] = []
+        self.figures: list = []
+        self.sidebar = _Sidebar(self)
+        self.session_state: dict = {}
+
+    # layout / chrome
+    def set_page_config(self, **kw):
+        self.calls.append(("set_page_config",))
+
+    def title(self, text):
+        self.calls.append(("title", text))
+
+    def subheader(self, text):
+        self.calls.append(("subheader", text))
+
+    def caption(self, text):
+        self.calls.append(("caption", text))
+
+    def columns(self, n):
+        return [_Column() for _ in range(n)]
+
+    # inputs (scripted)
+    def number_input(self, label, value=0.0, min_value=None, max_value=None,
+                     step=None):
+        self.calls.append(("number_input", label))
+        return self.script.get("numbers", {}).get(label, value)
+
+    def selectbox(self, label, options, index=0):
+        self.calls.append(("selectbox", label))
+        return self.script.get("selects", {}).get(label, options[index])
+
+    def checkbox(self, label):
+        self.calls.append(("checkbox", label))
+        return label in self.script.get("checked", ())
+
+    def button(self, label):
+        self.calls.append(("button", label))
+        return self.script.get("press_buttons", True)
+
+    def file_uploader(self, label, type=None):
+        self.calls.append(("file_uploader", label))
+        return self.script.get("upload")
+
+    # outputs
+    def success(self, text):
+        self.calls.append(("success", text))
+
+    def error(self, text):
+        self.errors.append(str(text))
+
+    def info(self, text):
+        self.errors.append(str(text))  # explorer fallback counts as failure
+
+    def pyplot(self, fig):
+        self.figures.append(fig)
+
+    def dataframe(self, df):
+        self.calls.append(("dataframe", len(df)))
+
+    def download_button(self, label, data, filename):
+        self.calls.append(("download_button", filename))
+
+
+class _Upload:
+    def __init__(self, name, data):
+        self.name = name
+        self._data = data
+
+    def getvalue(self):
+        return self._data
+
+
+@pytest.fixture(scope="module")
+def live_server(serving_artifact):
+    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, X = serving_artifact
+    server = make_server(ScorerService.from_store(store), "127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_address[1]}", X
+    server.shutdown()
+
+
+def _run_app(monkeypatch, url, script):
+    st = _FakeStreamlit(script)
+    monkeypatch.setitem(sys.modules, "streamlit", st)
+    monkeypatch.setenv("API_URL", url)
+    import matplotlib
+
+    matplotlib.use("Agg")
+    from cobalt_smart_lender_ai_tpu.ui import app
+
+    app.main()
+    return st
+
+
+def test_single_prediction_mode_renders(monkeypatch, live_server):
+    url, _ = live_server
+    st = _run_app(monkeypatch, url, {"mode": "Single Prediction"})
+    assert st.errors == []
+    # prediction succeeded and a waterfall figure was rendered
+    assert any(c[0] == "success" for c in st.calls)
+    assert len(st.figures) == 1
+    labels = [c[1] for c in st.calls if c[0] == "number_input"]
+    assert len(labels) == 11  # 12 numeric inputs minus the term selectbox
+
+
+def test_bulk_mode_renders_table_importance_and_row_explorer(
+    monkeypatch, live_server
+):
+    url, X = live_server
+    df = pd.DataFrame(
+        np.asarray(X[:6], dtype=np.float64),
+        columns=list(schema.SERVING_FEATURES),
+    )
+    script = {
+        "mode": "Bulk Prediction + SHAP",
+        "upload": _Upload("batch.csv", df.to_csv(index=False).encode()),
+        "numbers": {"Row to explain": 3},
+    }
+    st = _run_app(monkeypatch, url, script)
+    assert st.errors == []
+    assert ("dataframe", 6) in st.calls
+    assert any(c[0] == "download_button" for c in st.calls)
+    # importance barh + row-3 waterfall
+    assert len(st.figures) == 2
+    assert any(
+        c[0] == "caption" and "Row 3" in c[1] for c in st.calls
+    ), st.calls
+
+    # Streamlit rerun-on-interaction: the button reads False on the next run,
+    # but results persist in session_state so changing the explorer row still
+    # renders — the regression the session_state refactor exists to prevent.
+    from cobalt_smart_lender_ai_tpu.ui import app
+
+    st.script["press_buttons"] = False
+    st.script["numbers"] = {"Row to explain": 5}
+    app.main()
+    assert st.errors == []
+    assert any(
+        c[0] == "caption" and "Row 5" in c[1] for c in st.calls
+    ), "explorer did not survive the rerun"

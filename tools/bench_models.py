@@ -1,0 +1,176 @@
+"""Measured throughput + quality for the neural model families.
+
+Promotes the docstring numbers (models/train_loop.py) to driver-visible
+evidence: one JSON artifact (MODELS_BENCH.json) with measured training
+throughput and held-out AUC for MLP, FT-Transformer, and TabNet at a stated
+scale on the current backend.
+
+Method: the training loop is a host loop over one jitted epoch, so steady-
+state epoch throughput is measured as (rows x extra_epochs) / (t_long -
+t_short) across two fits that differ only in epoch count — the first fit's
+compile cost cancels out. Total fit wall (what a user experiences, compile
+included) is reported alongside. Timing trap on this backend: wall times are
+taken after fetching a scalar from the outputs (block_until_ready does not
+block over the tunnel; see .claude/skills/verify/SKILL.md).
+
+Usage: python tools/bench_models.py [--rows 262144] [--out MODELS_BENCH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _ready(model, Xte_args) -> float:
+    """Force execution: fetch a scalar derived from predictions."""
+    p = model.predict_proba(*Xte_args)
+    return float(np.asarray(p).sum())
+
+
+def bench_family(name, make_model, fit_args, val_kw, test_args, y_test,
+                 short=2, long=12):
+    from sklearn.metrics import roc_auc_score
+
+    rows = int(np.asarray(fit_args[-1]).shape[0])
+    t0 = time.time()
+    m = make_model(short)
+    m.fit(*fit_args, **val_kw)
+    _ready(m, test_args)
+    t_short = time.time() - t0
+    e_short = len(m.history["loss"])
+
+    t0 = time.time()
+    m = make_model(long)
+    m.fit(*fit_args, **val_kw)
+    _ready(m, test_args)
+    t_long = time.time() - t0
+    e_long = len(m.history["loss"])  # early stopping may trim this
+
+    # The compile cost (identical shapes) cancels between the two fits;
+    # divide by the epochs actually run, not the configured count.
+    if e_long > e_short:
+        steady = rows * (e_long - e_short) / max(t_long - t_short, 1e-9)
+    else:  # early stop clamped both fits: lower-bound from the long fit
+        steady = rows * e_long / max(t_long, 1e-9)
+    p = np.asarray(m.predict_proba(*test_args)[:, 1])
+    auc = float(roc_auc_score(np.asarray(y_test), p))
+    return {
+        "rows": rows,
+        "epochs_run": [e_short, e_long],
+        "fit_seconds_incl_compile": round(t_long, 1),
+        "steady_rows_per_sec": round(steady),
+        "test_auc": round(auc, 4),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=262_144)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from cobalt_smart_lender_ai_tpu.config import (
+        FTTransformerConfig,
+        MLPConfig,
+    )
+    from cobalt_smart_lender_ai_tpu.data import (
+        clean_raw_frame,
+        engineer_features,
+        prepare_cleaned_frame,
+        synthetic_lendingclub_frame,
+        train_test_split_hashed,
+    )
+    from cobalt_smart_lender_ai_tpu.models.ft_transformer import (
+        FTTransformerClassifier,
+    )
+    from cobalt_smart_lender_ai_tpu.models.nn import MLPClassifier
+    from cobalt_smart_lender_ai_tpu.models.tabnet import (
+        TabNetClassifier,
+        TabNetConfig,
+    )
+
+    # The NN feature frame (numeric + label-encoded categoricals) is what the
+    # reference's Keras path consumes (feature_engineering.py nn frame).
+    raw = synthetic_lendingclub_frame(n_rows=args.rows, seed=13)
+    cleaned, _ = clean_raw_frame(raw)
+    _, nn_ff, plan = engineer_features(prepare_cleaned_frame(cleaned))
+    Xtr, Xte, ytr, yte = train_test_split_hashed(nn_ff.X, nn_ff.y)
+    Xtr_n, Xte_n = np.asarray(Xtr), np.asarray(Xte)
+    ytr_n, yte_n = np.asarray(ytr), np.asarray(yte)
+    # NaNs to 0 after the frames' imputation indicators already encoded them.
+    Xtr_n = np.nan_to_num(Xtr_n, nan=0.0)
+    Xte_n = np.nan_to_num(Xte_n, nan=0.0)
+
+    # The nn frame carries each categorical as a label-code column named after
+    # the raw column (data/features.py nn_names.append(c)); code len(vocab)
+    # means missing, hence the +1 embedding row.
+    names = list(nn_ff.feature_names)
+    cat_cols = [i for i, n in enumerate(names) if n in plan.categorical_vocab]
+    num_cols = [i for i in range(len(names)) if i not in cat_cols]
+    vocab_sizes = tuple(
+        len(plan.categorical_vocab[names[i]]) + 1 for i in cat_cols
+    )
+
+    results = {
+        "backend": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+        "train_rows": int(Xtr_n.shape[0]),
+        "features": len(names),
+    }
+
+    results["mlp"] = bench_family(
+        "mlp",
+        lambda e: MLPClassifier(MLPConfig(epochs=e, early_stop_patience=10_000)),
+        (Xtr_n, ytr_n),
+        {},
+        (Xte_n,),
+        yte_n,
+        short=2,
+        long=22,
+    )
+    print("mlp:", json.dumps(results["mlp"]))
+
+    if cat_cols:
+        ft_fit = (Xtr_n[:, num_cols], Xtr_n[:, cat_cols].astype(np.int32), ytr_n)
+        ft_test = (Xte_n[:, num_cols], Xte_n[:, cat_cols].astype(np.int32))
+        results["ft_transformer"] = bench_family(
+            "ft",
+            lambda e: FTTransformerClassifier(
+                vocab_sizes, FTTransformerConfig(epochs=e)
+            ),
+            ft_fit,
+            {},
+            ft_test,
+            yte_n,
+            short=1,
+            long=5,
+        )
+        print("ft_transformer:", json.dumps(results["ft_transformer"]))
+
+    results["tabnet"] = bench_family(
+        "tabnet",
+        lambda e: TabNetClassifier(TabNetConfig(epochs=e)),
+        (Xtr_n, ytr_n),
+        {},
+        (Xte_n,),
+        yte_n,
+        short=1,
+        long=8,
+    )
+    print("tabnet:", json.dumps(results["tabnet"]))
+
+    print(json.dumps(results, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+    return results
+
+
+if __name__ == "__main__":
+    main()
