@@ -69,6 +69,10 @@ def test_two_process_bootstrap_and_psum():
                 COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
                 NUM_PROCESSES="2",
                 PROCESS_ID=str(rank),
+                # The workers import the package by path, not install — their
+                # sys.path[0] is tests/, so the repo root must be explicit.
+                PYTHONPATH=str(Path(__file__).resolve().parent.parent)
+                + (os.pathsep + os.environ["PYTHONPATH"] if os.environ.get("PYTHONPATH") else ""),
             )
             # The workers must each see ONE local CPU device so the global
             # mesh truly spans processes; drop the 8-device virtualization.
