@@ -19,6 +19,7 @@ to localhost for bare-metal runs.
 
 from __future__ import annotations
 
+import hashlib
 import os
 
 from cobalt_smart_lender_ai_tpu.ui import core
@@ -78,6 +79,23 @@ def main() -> None:
     else:
         st.subheader("Upload CSV for Bulk Inference")
         uploaded = st.file_uploader("Upload CSV with required columns", type="csv")
+        # Cached results belong to exactly one upload: replacing or removing
+        # the file must drop them, or the page would keep rendering the
+        # previous file's predictions under the new upload. Streamlit's
+        # UploadedFile carries a stable per-upload file_id; fall back to a
+        # content hash for harnesses (and streamlits) without one — that path
+        # re-hashes the file each rerun, so prefer file_id when present.
+        if uploaded is None:
+            upload_key = None
+        else:
+            uid = getattr(uploaded, "file_id", None)
+            if uid is None:
+                uid = hashlib.md5(uploaded.getvalue()).hexdigest()
+            upload_key = f"{uploaded.name}:{uid}"
+        if st.session_state.get("bulk_upload_key") != upload_key:
+            st.session_state.pop("bulk_results", None)
+            st.session_state.pop("bulk_importance", None)
+            st.session_state["bulk_upload_key"] = upload_key
         if uploaded and st.button("Run Bulk Prediction"):
             try:
                 st.session_state["bulk_results"] = client.predict_bulk_csv(
@@ -86,6 +104,22 @@ def main() -> None:
             except Exception as e:
                 st.session_state.pop("bulk_results", None)
                 st.error(f"Prediction failed: {e}")
+            else:
+                # Importance is fetched once per run, not per rerun: the
+                # explorer's widgets retrigger the whole script, and
+                # re-posting every record to /feature_importance_bulk on each
+                # interaction would recompute bulk importances per keystroke.
+                # Its failure must not discard the successful predictions —
+                # the chart is simply skipped.
+                try:
+                    st.session_state["bulk_importance"] = (
+                        client.feature_importance_bulk(
+                            st.session_state["bulk_results"]
+                        )
+                    )
+                except Exception as e:
+                    st.session_state.pop("bulk_importance", None)
+                    st.error(f"Feature importance unavailable: {e}")
         # Results live in session_state so the explorer's widgets survive
         # Streamlit's rerun-on-interaction (the button is only True on the
         # run it was clicked).
@@ -100,15 +134,15 @@ def main() -> None:
                     df_result.to_csv(index=False),
                     "bulk_predictions.csv",
                 )
-                st.subheader("Feature Importance (Top 10)")
-                imp = core.importance_series(
-                    client.feature_importance_bulk(records)
-                )
-                fig, ax = plt.subplots()
-                ax.barh(list(imp.index)[::-1], list(imp.values)[::-1])
-                ax.set_xlabel("Importance (gain)")
-                ax.set_title("Top 10 Important Features")
-                st.pyplot(fig)
+                importance = st.session_state.get("bulk_importance")
+                if importance is not None:
+                    st.subheader("Feature Importance (Top 10)")
+                    imp = core.importance_series(importance)
+                    fig, ax = plt.subplots()
+                    ax.barh(list(imp.index)[::-1], list(imp.values)[::-1])
+                    ax.set_xlabel("Importance (gain)")
+                    ax.set_title("Top 10 Important Features")
+                    st.pyplot(fig)
 
                 # Per-row SHAP explorer — the reference notebook's row-slider
                 # force plots (04_model_training.ipynb cells 25-26), served

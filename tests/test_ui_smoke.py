@@ -188,3 +188,58 @@ def test_bulk_mode_renders_table_importance_and_row_explorer(
     assert any(
         c[0] == "caption" and "Row 5" in c[1] for c in st.calls
     ), "explorer did not survive the rerun"
+
+
+def test_bulk_results_invalidate_on_new_upload_and_importance_is_cached(
+    monkeypatch, live_server
+):
+    """A replaced upload must drop the previous file's cached results, and
+    explorer reruns must reuse the cached importance response instead of
+    re-posting every record to /feature_importance_bulk per interaction."""
+    from cobalt_smart_lender_ai_tpu.ui import app, core
+
+    url, X = live_server
+    cols = list(schema.SERVING_FEATURES)
+    df_a = pd.DataFrame(np.asarray(X[:4], dtype=np.float64), columns=cols)
+    df_b = pd.DataFrame(np.asarray(X[4:10], dtype=np.float64), columns=cols)
+
+    counts = {"importance": 0}
+    orig = core.ApiClient.feature_importance_bulk
+
+    def counting(self, records):
+        counts["importance"] += 1
+        return orig(self, records)
+
+    monkeypatch.setattr(core.ApiClient, "feature_importance_bulk", counting)
+
+    script = {
+        "mode": "Bulk Prediction + SHAP",
+        "upload": _Upload("a.csv", df_a.to_csv(index=False).encode()),
+    }
+    st = _run_app(monkeypatch, url, script)
+    assert st.errors == []
+    assert ("dataframe", 4) in st.calls
+    assert counts["importance"] == 1
+
+    # Explorer interaction rerun: cached results render, importance NOT refetched.
+    st.script["press_buttons"] = False
+    st.script["numbers"] = {"Row to explain": 2}
+    app.main()
+    assert st.errors == []
+    assert counts["importance"] == 1, "importance re-posted on a rerun"
+
+    # New upload without pressing Run: the old file's results must vanish.
+    st.script["upload"] = _Upload("b.csv", df_b.to_csv(index=False).encode())
+    n_tables = sum(1 for c in st.calls if c[0] == "dataframe")
+    app.main()
+    assert st.errors == []
+    assert sum(1 for c in st.calls if c[0] == "dataframe") == n_tables, (
+        "stale results rendered for a new upload"
+    )
+
+    # Running on the new upload scores it fresh.
+    st.script["press_buttons"] = True
+    app.main()
+    assert st.errors == []
+    assert ("dataframe", 6) in st.calls
+    assert counts["importance"] == 2
