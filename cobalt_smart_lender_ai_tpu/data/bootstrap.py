@@ -83,3 +83,38 @@ def bootstrap_synthetic(
     if registry is not None:
         registry.add(name, path)
     return path
+
+
+def main(argv=None) -> Path:
+    """CLI — the `python data/download_data.py` equivalent: fetch with
+    ``--url`` (md5-pinned when a registry store is given), or synthesize the
+    offline full-schema stand-in."""
+    import argparse
+
+    from cobalt_smart_lender_ai_tpu.io.store import ObjectStore
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workspace", default="data/1-raw")
+    ap.add_argument("--url", default=None,
+                    help="fetch this URL instead of synthesizing")
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--store", default=None,
+                    help="object-store URI; when given, the download/synth "
+                    "is md5-pinned in its DatasetRegistry")
+    args = ap.parse_args(argv)
+
+    registry = DatasetRegistry(ObjectStore(args.store)) if args.store else None
+    if args.url:
+        dest = Path(args.workspace) / Path(args.url.split("?")[0]).name
+        path = download_raw_archive(args.url, dest, registry)
+    else:
+        path = bootstrap_synthetic(
+            args.workspace, registry, n_rows=args.rows, seed=args.seed
+        )
+    print(path)
+    return path
+
+
+if __name__ == "__main__":
+    main()

@@ -31,21 +31,20 @@ def _ready(model, Xte_args) -> float:
     return float(np.asarray(p).sum())
 
 
-def bench_family(name, make_model, fit_args, val_kw, test_args, y_test,
-                 short=2, long=12):
+def bench_family(make_model, fit_args, test_args, y_test, short=2, long=12):
     from sklearn.metrics import roc_auc_score
 
     rows = int(np.asarray(fit_args[-1]).shape[0])
     t0 = time.time()
     m = make_model(short)
-    m.fit(*fit_args, **val_kw)
+    m.fit(*fit_args)
     _ready(m, test_args)
     t_short = time.time() - t0
     e_short = len(m.history["loss"])
 
     t0 = time.time()
     m = make_model(long)
-    m.fit(*fit_args, **val_kw)
+    m.fit(*fit_args)
     _ready(m, test_args)
     t_long = time.time() - t0
     e_long = len(m.history["loss"])  # early stopping may trim this
@@ -125,10 +124,8 @@ def main(argv=None):
     }
 
     results["mlp"] = bench_family(
-        "mlp",
         lambda e: MLPClassifier(MLPConfig(epochs=e, early_stop_patience=10_000)),
         (Xtr_n, ytr_n),
-        {},
         (Xte_n,),
         yte_n,
         short=2,
@@ -140,12 +137,10 @@ def main(argv=None):
         ft_fit = (Xtr_n[:, num_cols], Xtr_n[:, cat_cols].astype(np.int32), ytr_n)
         ft_test = (Xte_n[:, num_cols], Xte_n[:, cat_cols].astype(np.int32))
         results["ft_transformer"] = bench_family(
-            "ft",
             lambda e: FTTransformerClassifier(
                 vocab_sizes, FTTransformerConfig(epochs=e)
             ),
             ft_fit,
-            {},
             ft_test,
             yte_n,
             short=1,
@@ -154,10 +149,8 @@ def main(argv=None):
         print("ft_transformer:", json.dumps(results["ft_transformer"]))
 
     results["tabnet"] = bench_family(
-        "tabnet",
         lambda e: TabNetClassifier(TabNetConfig(epochs=e)),
         (Xtr_n, ytr_n),
-        {},
         (Xte_n,),
         yte_n,
         short=1,
