@@ -88,7 +88,7 @@ def build_matrices(n_rows: int, seed: int):
     }
 
 
-def run_ours(mats, chunk_trees: int | None = 50) -> dict:
+def run_ours(mats, chunk_trees: int | None = 12) -> dict:
     """This framework's protocol on the shared matrices — the L3 block of
     pipeline.run_pipeline, run directly so both sides consume the same
     arrays."""
@@ -231,7 +231,7 @@ def run_oracle(mats, seed: int = 22) -> dict:
     }
 
 
-def run_head_to_head(n_rows: int, seed: int = 11, chunk_trees: int | None = 50):
+def run_head_to_head(n_rows: int, seed: int = 11, chunk_trees: int | None = 12):
     """Both sides in one process (used by the slow-marked test, where the
     conftest pins everything to the virtual CPU mesh)."""
     mats = build_matrices(n_rows, seed)
@@ -260,7 +260,10 @@ def main(argv=None):
     ap.add_argument("inputs", nargs="*", help="json files for merge")
     ap.add_argument("--rows", type=int, default=130_000)
     ap.add_argument("--seed", type=int, default=11)
-    ap.add_argument("--chunk-trees", type=int, default=50)
+    # Dispatch budget: the depth-9 search bucket runs 33 vmapped jobs per
+    # dispatch; 50-tree chunks at 130k rows crashed the tunneled TPU worker
+    # (dispatch past the environment's ~60s tolerance), 12 stays well under.
+    ap.add_argument("--chunk-trees", type=int, default=12)
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
@@ -272,7 +275,16 @@ def main(argv=None):
                 f"merge needs one 'ours' and one 'oracle' file, got sides "
                 f"{[d.get('side') for d in loaded]}"
             )
-        result = merge(by_side["ours"], by_side["oracle"])
+        meta = {}
+        for k in ("n_rows", "seed"):
+            vals = {d.get(k) for d in loaded}
+            if len(vals) != 1 or None in vals:
+                raise SystemExit(
+                    f"sides disagree on {k} ({vals}) — they did not run on "
+                    "identical matrices; re-run with matching --rows/--seed"
+                )
+            meta[k] = vals.pop()
+        result = merge(by_side["ours"], by_side["oracle"], **meta)
     elif args.side == "both":
         result = run_head_to_head(args.rows, args.seed, args.chunk_trees)
     else:
