@@ -45,6 +45,11 @@ def download_raw_archive(
     under ``pin_name``. Raises ConnectionError with a remediation hint when
     the network is unreachable (the normal case on an air-gapped TPU pod)."""
     dest = Path(dest)
+    if dest.is_dir():
+        raise ValueError(
+            f"destination {str(dest)!r} is a directory — pass the full file "
+            "path the archive should be written to"
+        )
     dest.parent.mkdir(parents=True, exist_ok=True)
     try:
         with urllib.request.urlopen(url, timeout=timeout) as r:
@@ -56,9 +61,25 @@ def download_raw_archive(
             "DatasetRegistry.add(name, path) — or use bootstrap_synthetic() "
             "for a full-schema offline stand-in."
         ) from e
+    name = pin_name or dest.name
+    # dvc-pull-equivalent integrity: a download claiming to be one of the
+    # reference's pinned raw datasets must hash to that pin, or it is
+    # rejected before anything is written or (re-)pinned.
+    from cobalt_smart_lender_ai_tpu.io.registry import REFERENCE_RAW_PINS, _md5
+
+    known = {p.path: p for p in REFERENCE_RAW_PINS}
+    if name in known:
+        pin = known[name]
+        got_md5, got_size = _md5(data), len(data)
+        if (got_md5, got_size) != (pin.md5, pin.size):
+            raise ValueError(
+                f"download of {name!r} does not match its reference pin: "
+                f"got md5={got_md5} size={got_size}, "
+                f"pinned md5={pin.md5} size={pin.size} — refusing to save"
+            )
     dest.write_bytes(data)
     if registry is not None:
-        registry.add(pin_name or dest.name, data)
+        registry.add(name, data)
     return dest
 
 
@@ -106,8 +127,19 @@ def main(argv=None) -> Path:
 
     registry = DatasetRegistry(ObjectStore(args.store)) if args.store else None
     if args.url:
-        dest = Path(args.workspace) / Path(args.url.split("?")[0]).name
-        path = download_raw_archive(args.url, dest, registry)
+        from urllib.parse import urlparse
+
+        url_path = urlparse(args.url).path
+        fname = Path(url_path).name
+        if not fname or url_path.endswith("/"):
+            ap.error(
+                f"--url {args.url!r} has no file name in its path — "
+                "directory-style URLs (e.g. a Drive folder link) carry no "
+                "downloadable file; point at the file itself"
+            )
+        path = download_raw_archive(
+            args.url, Path(args.workspace) / fname, registry
+        )
     else:
         path = bootstrap_synthetic(
             args.workspace, registry, n_rows=args.rows, seed=args.seed
