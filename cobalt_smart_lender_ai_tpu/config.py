@@ -94,6 +94,10 @@ class FTTransformerConfig:
     weight_decay: float = 1e-5
     batch_size: int = 1024
     epochs: int = 20
+    #: Validation-eval / scoring chunk size: attention materializes a
+    #: (rows, heads, tokens, tokens) transient, so full-batch forwards OOM
+    #: 16GB HBM around ~50k rows x 69 tokens. Shrink on smaller devices.
+    eval_batch_rows: int = 16384
     seed: int = 0
 
 

@@ -11,6 +11,7 @@ parallel over the batch via sharded jit.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 
 import flax.linen as nn
 import jax
@@ -84,6 +85,15 @@ jax.tree_util.register_dataclass(
 )
 
 
+@partial(jax.jit, static_argnums=0)
+def _apply_deterministic(module: FTTransformer, params, x_num, x_cat):
+    """Module-level jitted inference forward. The module (a frozen flax
+    dataclass) rides as a static arg, so the compile is shared by every
+    classifier instance with the same architecture and shapes — a per-call
+    or per-instance jit wrapper would recompile the transformer each time."""
+    return module.apply(params, x_num, x_cat, deterministic=True)
+
+
 class FTTransformerClassifier:
     """Facade over (x_num, x_cat) inputs. Categorical columns are integer
     label codes (the NN feature path's encoding, `data/features.py`); codes
@@ -149,6 +159,9 @@ class FTTransformerClassifier:
             weight_decay=cfg.weight_decay,
             pos_weight=pos_weight,
             seed=cfg.seed,
+            # Attention's (rows, heads, tokens, tokens) transient makes a
+            # full-batch validation forward OOM at large row counts.
+            val_batch_rows=cfg.eval_batch_rows,
         )
         self.params, self.history = fit_binary(
             apply_fn,
@@ -162,12 +175,38 @@ class FTTransformerClassifier:
         )
         return self
 
-    def predict_logits(self, X_num, X_cat) -> jax.Array:
+    def predict_logits(self, X_num, X_cat, batch_rows: int | None = None) -> jax.Array:
+        """Scores in fixed-shape row chunks: attention materializes a
+        (rows, heads, tokens, tokens) transient, so a single full-batch
+        forward OOMs 16GB HBM around ~50k rows x 69 tokens. Chunks reuse one
+        compiled program (the tail is zero-padded, not ragged)."""
         assert self.params is not None and self.scaler is not None, "fit first"
+        if batch_rows is None:
+            batch_rows = self.config.eval_batch_rows
         X_num, X_cat = self._prep(X_num, X_cat)
-        return self.module.apply(
-            self.params, self.scaler(X_num), X_cat, deterministic=True
+        X_num = self.scaler(X_num)
+        n = X_num.shape[0]
+        if n <= batch_rows:
+            return self.module.apply(
+                self.params, X_num, X_cat, deterministic=True
+            )
+        pad = (-n) % batch_rows
+        X_num = jnp.concatenate(
+            [X_num, jnp.zeros((pad, X_num.shape[1]), X_num.dtype)]
         )
+        X_cat = jnp.concatenate(
+            [X_cat, jnp.zeros((pad, X_cat.shape[1]), X_cat.dtype)]
+        )
+        out = [
+            _apply_deterministic(
+                self.module,
+                self.params,
+                X_num[i : i + batch_rows],
+                X_cat[i : i + batch_rows],
+            )
+            for i in range(0, n + pad, batch_rows)
+        ]
+        return jnp.concatenate(out)[:n]
 
     def predict_proba(self, X_num, X_cat) -> jax.Array:
         p1 = jax.nn.sigmoid(self.predict_logits(X_num, X_cat))
