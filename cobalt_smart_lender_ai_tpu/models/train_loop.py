@@ -19,11 +19,16 @@ EarlyStopping), TPU-first:
 Batches are zero-weight padded so shapes stay static; the weighted loss makes
 padding inert.
 
-Measured on TPU v5e (one chip): a 128/32/16 MLP epoch over 500k x 98 rows at
-batch 4096 runs in ~0.18s once compiled — ~2.7M rows/s, vs the reference
-Keras MLP's ~26k rows/s on CPU (BASELINE.md). The jitted epoch closes over
-the padded data, so each `fit_binary` call compiles its own program
-(~30-60s on a cold cache); amortize by keeping fits long, not by re-calling.
+Measured throughput lives in `MODELS_BENCH.json` (produced by
+`tools/bench_models.py`, forced-execution timing): on this tunneled v5e
+chip the 128/32/16 MLP trains at ~33k rows/s steady state at 210k rows x
+batch 1024 (reference Keras MLP: ~26k rows/s on CPU, BASELINE.md). An
+earlier figure of ~2.7M rows/s quoted here was measured with
+`block_until_ready`, which returns immediately on the tunneled backend and
+under-reports wall time — treat any number not derived from a fetched
+scalar as suspect. The jitted epoch closes over the padded data, so each
+`fit_binary` call compiles its own program (~30-60s on a cold cache);
+amortize by keeping fits long, not by re-calling.
 """
 
 from __future__ import annotations
