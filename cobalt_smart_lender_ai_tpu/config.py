@@ -78,6 +78,9 @@ class MLPConfig:
     early_stop_patience: int = 5
     early_stop_metric: str = "val_auc"  # fixes the reference's val_precision-name bug
     positive_class_weight: float | None = None  # None => balanced (replaces SMOTE)
+    #: Epochs per host round-trip (early-stop state lives on device, so any
+    #: value gives identical results; larger amortizes host sync).
+    epochs_per_dispatch: int = 8
     seed: int = 0
 
 
@@ -98,6 +101,10 @@ class FTTransformerConfig:
     #: (rows, heads, tokens, tokens) transient, so full-batch forwards OOM
     #: 16GB HBM around ~50k rows x 69 tokens. Shrink on smaller devices.
     eval_batch_rows: int = 16384
+    #: Epochs per host round-trip (identical results for any value). Kept
+    #: low: one FT epoch is heavy, and K x epoch time must stay under the
+    #: runtime's dispatch tolerance.
+    epochs_per_dispatch: int = 2
     seed: int = 0
 
 

@@ -162,6 +162,7 @@ class FTTransformerClassifier:
             # Attention's (rows, heads, tokens, tokens) transient makes a
             # full-batch validation forward OOM at large row counts.
             val_batch_rows=cfg.eval_batch_rows,
+            epochs_per_dispatch=cfg.epochs_per_dispatch,
         )
         self.params, self.history = fit_binary(
             apply_fn,

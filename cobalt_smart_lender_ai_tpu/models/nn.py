@@ -100,6 +100,7 @@ class MLPClassifier:
             l2=cfg.l2,
             pos_weight=pos_weight,
             early_stop_patience=cfg.early_stop_patience,
+            epochs_per_dispatch=cfg.epochs_per_dispatch,
             seed=cfg.seed,
         )
         self.params, self.history = fit_binary(

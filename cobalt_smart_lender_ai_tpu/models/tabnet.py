@@ -136,6 +136,8 @@ class TabNetConfig:
     learning_rate: float = 2e-2
     batch_size: int = 4096
     epochs: int = 30
+    #: Epochs per host round-trip (identical results for any value).
+    epochs_per_dispatch: int = 8
     seed: int = 0
 
 
@@ -176,6 +178,7 @@ class TabNetClassifier:
             batch_size=cfg.batch_size,
             epochs=cfg.epochs,
             learning_rate=cfg.learning_rate,
+            epochs_per_dispatch=cfg.epochs_per_dispatch,
             seed=cfg.seed,
         )
         if (X_val is None) != (y_val is None):

@@ -66,6 +66,15 @@ class TrainSettings:
     #: super-linear transients — e.g. FT-Transformer attention materializes
     #: (rows, heads, tokens, tokens), which OOMs 16GB HBM around ~50k rows.
     val_batch_rows: int | None = None
+    #: Epochs advanced per host round-trip. Early-stop bookkeeping (best
+    #: params, patience counter) lives ON DEVICE, so results are bit-identical
+    #: to per-epoch dispatch for any value — larger values only amortize the
+    #: host<->device sync (measured seconds per epoch over a tunneled
+    #: backend, and still a fetch on real hosts). Epochs after an early stop
+    #: are cond-skipped on device (no wasted compute); the only cost of a
+    #: large K is dispatch granularity — keep K x one-epoch device time
+    #: under the runtime's dispatch tolerance (~60s here).
+    epochs_per_dispatch: int = 1
 
 
 def _num_rows(X: Batch) -> int:
@@ -152,7 +161,6 @@ def fit_binary(
             + aux
         )
 
-    @jax.jit
     def train_epoch(p, opt_state, rng):
         perm_rng, scan_rng = jax.random.split(rng)
         perm = jax.random.permutation(perm_rng, n_padded)
@@ -201,43 +209,100 @@ def fit_binary(
             [jnp.asarray(y_val, jnp.float32), jnp.zeros(pad, jnp.float32)]
         )
 
-        @jax.jit
         def val_auc_fn(p):
             logits = jax.lax.map(
                 lambda chunk: _logits_of(p, chunk), Xv_chunks
             ).reshape(-1)
             return roc_auc(y_val_p, logits, weight=val_w)
 
-    else:
+    elif X_val is not None:
 
-        @jax.jit
+        y_val_f = jnp.asarray(y_val, jnp.float32)
+
         def val_auc_fn(p):
-            return roc_auc(
-                jnp.asarray(y_val, jnp.float32), _logits_of(p, X_val)
-            )
+            return roc_auc(y_val_f, _logits_of(p, X_val))
 
-    rng = jax.random.PRNGKey(s.seed)
-    history = {"loss": [], "val_auc": []}
-    best_auc, best_params, wait = -np.inf, params, 0
-    for epoch in range(s.epochs):
+    has_val = X_val is not None
+
+    # --- K-epoch super-steps with on-device early-stop bookkeeping ----------
+    # The per-epoch state machine (best params, best AUC, patience counter,
+    # running/stopped/diverged) lives in the scan carry, so one dispatch
+    # advances K epochs and the host syncs once per K — bit-identical to the
+    # per-epoch host loop (same RNG split order, same update rule; epochs
+    # after a stop are cond-skipped, so nothing past the stop is computed).
+    # RUNNING=0, STOPPED_EARLY=1, DIVERGED=2 ride an int32 state.
+    K = max(1, min(s.epochs_per_dispatch, s.epochs))
+
+    def _epoch_body(carry, _):
+        p, o, bp, ba, wait, state, ep, rng = carry
         rng, sub = jax.random.split(rng)
-        params, opt_state, loss = train_epoch(params, opt_state, sub)
-        loss_f = float(loss)
-        if s.check_finite and not np.isfinite(loss_f):
+
+        def do_epoch(args):
+            p, o, bp, ba, wait, state = args
+            p2, o2, loss = train_epoch(p, o, sub)
+            diverged = (~jnp.isfinite(loss)) if s.check_finite else jnp.bool_(False)
+            if has_val:
+                auc = val_auc_fn(p2)
+                improved = auc > ba + s.early_stop_min_delta
+                bp2 = jax.tree.map(
+                    lambda a, b: jnp.where(improved, a, b), p2, bp
+                )
+                ba2 = jnp.where(improved, auc, ba)
+                wait2 = jnp.where(improved, 0, wait + 1)
+                early = wait2 >= s.early_stop_patience
+            else:
+                auc = jnp.float32(jnp.nan)
+                bp2, ba2, wait2 = p2, ba, wait
+                early = jnp.bool_(False)
+            state2 = jnp.where(
+                diverged, jnp.int32(2), jnp.where(early, jnp.int32(1), state)
+            )
+            return (p2, o2, bp2, ba2, wait2, state2), (loss, auc, jnp.float32(1.0))
+
+        def skip_epoch(args):
+            p, o, bp, ba, wait, state = args
+            nan = jnp.float32(jnp.nan)
+            return (p, o, bp, ba, wait, state), (nan, nan, jnp.float32(0.0))
+
+        active = (state == 0) & (ep < s.epochs)
+        (p, o, bp, ba, wait, state), out = jax.lax.cond(
+            active, do_epoch, skip_epoch, (p, o, bp, ba, wait, state)
+        )
+        return (p, o, bp, ba, wait, state, ep + 1, rng), out
+
+    @jax.jit
+    def super_step(carry):
+        return jax.lax.scan(_epoch_body, carry, None, length=K)
+
+    carry = (
+        params,
+        opt_state,
+        params,  # best params so far
+        jnp.float32(-jnp.inf),
+        jnp.int32(0),  # patience counter
+        jnp.int32(0),  # state
+        jnp.int32(0),  # global epoch index
+        jax.random.PRNGKey(s.seed),
+    )
+    history = {"loss": [], "val_auc": []}
+    for _ in range(-(-s.epochs // K)):
+        carry, (losses, aucs, ran) = super_step(carry)
+        # One host sync per K epochs: fetch the K-length history slices and
+        # the state scalar together.
+        losses, aucs, ran = (np.asarray(a) for a in (losses, aucs, ran))
+        state = int(carry[5])
+        ran_mask = ran > 0.5
+        if state == 2:  # diverged: replicate the per-epoch loop's raise
+            bad = int(np.flatnonzero(ran_mask)[-1])
+            epoch = len(history["loss"]) + bad
             raise FloatingPointError(
-                f"epoch {epoch}: training loss is {loss_f} — diverged "
+                f"epoch {epoch}: training loss is {losses[bad]} — diverged "
                 "(inspect with cobalt_smart_lender_ai_tpu.debug.nan_guard)"
             )
-        history["loss"].append(loss_f)
-        if X_val is not None:
-            auc = float(val_auc_fn(params))
-            history["val_auc"].append(auc)
-            if auc > best_auc + s.early_stop_min_delta:
-                best_auc, best_params, wait = auc, params, 0
-            else:
-                wait += 1
-                if wait >= s.early_stop_patience:
-                    break
-        else:
-            best_params = params
+        history["loss"].extend(losses[ran_mask].tolist())
+        if has_val:
+            history["val_auc"].extend(aucs[ran_mask].tolist())
+        if state != 0:
+            break
+    best_params = carry[2] if has_val else carry[0]
     return best_params, history

@@ -1,6 +1,7 @@
 """MLP + FT-Transformer tests: learning on the engineered feature frame,
 early stopping on validation AUC, class weighting, dropout determinism."""
 
+import jax
 import numpy as np
 import pytest
 from sklearn.metrics import roc_auc_score
@@ -109,3 +110,30 @@ def test_ft_transformer_chunked_predict_matches_single_shot(ft_data):
     whole = np.asarray(ft.predict_logits(Xn[:300], Xc[:300]))
     chunked = np.asarray(ft.predict_logits(Xn[:300], Xc[:300], batch_rows=128))
     np.testing.assert_allclose(chunked, whole, rtol=1e-5, atol=1e-6)
+
+
+def test_epochs_per_dispatch_is_bit_identical():
+    """K-epoch super-steps keep the early-stop state machine on device; for
+    ANY K the selected params, history, and early-stop epoch must equal the
+    per-epoch (K=1) loop — same RNG split order, same update rule."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(600, 12)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] + rng.logistic(size=600) * 0.4 > 0).astype(np.int32)
+
+    def run(k):
+        m = MLPClassifier(
+            MLPConfig(
+                hidden_sizes=(16, 8), epochs=12, batch_size=128,
+                early_stop_patience=3, epochs_per_dispatch=k, seed=3,
+            )
+        )
+        m.fit(X, y)
+        return m
+
+    a, b, c = run(1), run(5), run(12)
+    assert a.history["loss"] == b.history["loss"] == c.history["loss"]
+    assert a.history["val_auc"] == b.history["val_auc"] == c.history["val_auc"]
+    pa = jax.tree.leaves(a.params)
+    for other in (b, c):
+        for la, lo in zip(pa, jax.tree.leaves(other.params)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lo))
