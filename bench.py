@@ -26,10 +26,22 @@ and BENCH notes, not here.
 
 Prints exactly one JSON line. ``--profile DIR`` wraps the timed run in a
 `jax.profiler` trace (SURVEY §5.1).
+
+``--protocol`` instead times the FULL training protocol — the whole
+`run_pipeline` composition (clean -> engineer -> RFE-20 step 1 -> 20x3
+randomized search over the reference's space -> final fit + eval,
+`model_tree_train_test.py:73-242`) on a synthetic raw frame of ``--rows``
+rows — and prints that as the one JSON line. This is the north-star sentence
+measured literally, every sequential refit and CV fit included; expect
+hours, not seconds, at 2.3M rows on one chip. Its committed output lives in
+`BENCH_PROTOCOL.json`; the default (single-fit) line embeds that artifact's
+summary under ``protocol`` with its provenance so both metrics ride every
+`BENCH_r*.json`.
 """
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -40,11 +52,84 @@ N_TREES, MAX_DEPTH, N_BINS = 300, 3, 64
 CHUNK_TREES = 100  # keep each dispatch well under the ~60s environment limit
 
 
+def run_protocol(n_rows: int, seed: int = 5) -> dict:
+    """Time the whole `run_pipeline` protocol on a synthetic raw frame.
+
+    Dispatch budget at full-table scale: the depth-9 search bucket runs 33
+    vmapped (candidate x fold) jobs per dispatch, so `chunk_trees=2` keeps
+    each chunk ~35s on a v5e chip — under the environment's ~60s dispatch
+    tolerance — while the tail-padded schedule still compiles one program
+    per depth bucket. The final refit (up to 300 trees, depth 9, 255 bins)
+    is chunked the same way via the base GBDT config.
+    """
+    import dataclasses
+
+    import jax
+
+    from cobalt_smart_lender_ai_tpu.config import PipelineConfig
+    from cobalt_smart_lender_ai_tpu.data.synthetic import (
+        synthetic_lendingclub_frame,
+    )
+    from cobalt_smart_lender_ai_tpu.pipeline import run_pipeline
+
+    cfg = PipelineConfig(save_intermediate=False)
+    cfg = dataclasses.replace(
+        cfg,
+        gbdt=cfg.gbdt.replace(chunk_trees=25),
+        tune=dataclasses.replace(cfg.tune, chunk_trees=2),
+        # Chunked RFE refits: the selector's one-dispatch shard_map compile
+        # at this scale crashes the remote-compile service (reproduced 2x).
+        rfe=dataclasses.replace(cfg.rfe, chunk_trees=25),
+    )
+    t0 = time.time()
+    raw = synthetic_lendingclub_frame(n_rows=n_rows, seed=seed)
+    t_gen = time.time() - t0
+
+    t1 = time.time()
+    result = run_pipeline(cfg, raw=raw)
+    total = time.time() - t1
+    return {
+        "metric": "full_protocol_rows_per_sec_per_chip",
+        "value": round(n_rows / total, 1),
+        "unit": (
+            f"rows/s ({n_rows/1e6:.1f}M-row raw frame through the whole "
+            f"protocol — clean+engineer+RFE-20(step1)+search(20x3, full "
+            f"reference space)+final fit+eval — in {total:.0f}s on one chip; "
+            f"test AUC {result.test_auc:.4f}, cv AUC {result.cv_auc:.4f}; "
+            "vs_baseline = x over the 4,791 rows/s/chip v4-8 <60s budget)"
+        ),
+        "vs_baseline": round(
+            n_rows / total / NORTH_STAR_ROWS_PER_SEC_PER_CHIP, 3
+        ),
+        "seconds_total": round(total, 1),
+        "seconds_stage": result.timings,
+        "seconds_synthetic_datagen_excluded": round(t_gen, 1),
+        "test_auc": round(result.test_auc, 4),
+        "cv_auc": round(result.cv_auc, 4),
+        "best_params": result.best_params,
+        "n_rows": n_rows,
+        "device": str(jax.devices()[0]),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--profile", default=None, help="jax.profiler trace dir")
     parser.add_argument("--rows", type=int, default=N_ROWS)
+    parser.add_argument(
+        "--protocol",
+        action="store_true",
+        help="time the full run_pipeline protocol instead of the single fit",
+    )
     args = parser.parse_args()
+
+    if args.protocol:
+        from cobalt_smart_lender_ai_tpu.debug import profile_trace as _trace
+
+        with _trace(args.profile):
+            out = run_protocol(args.rows)
+        print(json.dumps(out))
+        return
 
     import jax
     import jax.numpy as jnp
@@ -106,21 +191,36 @@ def main() -> None:
         elapsed = time.time() - t0
 
     rows_per_sec = n / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": "full_table_e2e_rows_per_sec_per_chip",
-                "value": round(rows_per_sec, 1),
-                "unit": (
-                    f"rows/s ({n/1e6:.1f}M rows, bin+300-tree-fit+predict+AUC "
-                    f"in {elapsed:.1f}s, held-out AUC {auc:.3f}; "
-                    "vs_baseline = x over the 4,791 rows/s/chip the v4-8 "
-                    "<60s north star requires)"
-                ),
-                "vs_baseline": round(rows_per_sec / NORTH_STAR_ROWS_PER_SEC_PER_CHIP, 2),
-            }
-        )
-    )
+    line = {
+        "metric": "full_table_e2e_rows_per_sec_per_chip",
+        "value": round(rows_per_sec, 1),
+        "unit": (
+            f"rows/s ({n/1e6:.1f}M rows, bin+300-tree-fit+predict+AUC "
+            f"in {elapsed:.1f}s, held-out AUC {auc:.3f}; "
+            "vs_baseline = x over the 4,791 rows/s/chip the v4-8 "
+            "<60s north star requires)"
+        ),
+        "vs_baseline": round(rows_per_sec / NORTH_STAR_ROWS_PER_SEC_PER_CHIP, 2),
+    }
+    # Ride the committed full-protocol measurement (bench.py --protocol, a
+    # multi-hour run not repeated per invocation) along the single line, with
+    # provenance, so BENCH_r*.json carries both metrics.
+    proto_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_PROTOCOL.json")
+    if os.path.exists(proto_path):
+        with open(proto_path) as f:
+            proto = json.load(f)
+        line["protocol"] = {
+            "source": "BENCH_PROTOCOL.json (bench.py --protocol; measured on "
+            + proto.get("device", "?") + ")",
+            "rows_per_sec_per_chip": proto.get("value"),
+            "seconds_total": proto.get("seconds_total"),
+            "seconds_stage": proto.get("seconds_stage"),
+            "test_auc": proto.get("test_auc"),
+            "n_rows": proto.get("n_rows"),
+            "vs_baseline": proto.get("vs_baseline"),
+        }
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

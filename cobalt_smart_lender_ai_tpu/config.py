@@ -149,6 +149,13 @@ class RFEConfig:
     max_depth: int = 6
     scale_pos_weight: float = 1.0  # reference passes it to the RFE estimator
     seed: int = 42
+    #: Boosting rounds per dispatch for each selector refit (margins carried,
+    #: numerically identical). On a single-device mesh this routes through
+    #: `fit_binned_chunked`; at full-table scale the one-dispatch shard_map
+    #: fit's compile reliably kills this environment's remote-compile service,
+    #: and the chunked program is the proven-working shape. None = single
+    #: dispatch.
+    chunk_trees: int | None = None
 
 
 @dataclasses.dataclass(frozen=True)

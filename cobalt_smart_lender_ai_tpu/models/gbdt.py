@@ -446,9 +446,16 @@ def fit_binned_chunked(
             tree_offset=jnp.int32(off),
         )
         chunks.append(forest_c)
-    # Trim tail-padding trees so the forest matches the unchunked fit
-    # exactly. (The padded slots are inert for predictions either way —
-    # global tree index >= hp.n_estimators zeroes their leaf values.)
+    return concat_forest_chunks(chunks, n_trees_cap, depth_cap)
+
+
+def concat_forest_chunks(
+    chunks: list[Forest], n_trees_cap: int, depth_cap: int
+) -> Forest:
+    """Concatenate per-chunk forests along the tree axis, trimming the tail
+    padding so the result matches the unchunked fit exactly. (The padded
+    slots are inert for predictions either way — global tree index >=
+    hp.n_estimators zeroes their leaf values.)"""
     return Forest(
         feature=jnp.concatenate([c.feature for c in chunks])[:n_trees_cap],
         thr_bin=jnp.concatenate([c.thr_bin for c in chunks])[:n_trees_cap],

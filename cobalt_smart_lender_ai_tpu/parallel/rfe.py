@@ -36,10 +36,14 @@ from cobalt_smart_lender_ai_tpu.config import GBDTConfig, MeshConfig, RFEConfig
 from cobalt_smart_lender_ai_tpu.models.gbdt import (
     GBDTHyperparams,
     fit_binned,
+    fit_binned_chunked,
     gain_importances,
 )
 from cobalt_smart_lender_ai_tpu.ops.binning import compute_bin_edges, transform
-from cobalt_smart_lender_ai_tpu.parallel.sharded import fit_binned_dp
+from cobalt_smart_lender_ai_tpu.parallel.sharded import (
+    fit_binned_dp,
+    fit_binned_dp_chunked,
+)
 
 
 @dataclasses.dataclass
@@ -138,31 +142,35 @@ def rfe_select(
         if score_mask is not None:
             score_mask(mask)
         fm = jnp.asarray(mask)
-        if mesh is not None:
+        kw = dict(
+            n_trees_cap=cfg.n_estimators,
+            depth_cap=cfg.max_depth,
+            n_bins=n_bins,
+        )
+        single_device = mesh is None or mesh.devices.size == 1
+        if cfg.chunk_trees and single_device:
+            # Chunked refits (margins carried, numerically identical): at
+            # full-table scale the whole-fit program's compile strains this
+            # environment's remote-compile service, while the chunked
+            # resumable program is the bench-proven shape. A 1-device mesh
+            # makes shard_map a no-op, so skip it entirely here.
+            forest = fit_binned_chunked(
+                bins, y, sw, fm, hp, jax.random.fold_in(rng, it),
+                chunk_trees=cfg.chunk_trees, **kw,
+            )
+        elif cfg.chunk_trees and mesh is not None:
+            forest = fit_binned_dp_chunked(
+                mesh, bins, y, sw, fm, hp, jax.random.fold_in(rng, it),
+                chunk_trees=cfg.chunk_trees, dp_axis=dp_axis, **kw,
+            )
+        elif mesh is not None:
             forest = fit_binned_dp(
-                mesh,
-                bins,
-                y,
-                sw,
-                fm,
-                hp,
-                jax.random.fold_in(rng, it),
-                n_trees_cap=cfg.n_estimators,
-                depth_cap=cfg.max_depth,
-                n_bins=n_bins,
-                dp_axis=dp_axis,
+                mesh, bins, y, sw, fm, hp, jax.random.fold_in(rng, it),
+                dp_axis=dp_axis, **kw,
             )
         else:
             forest = fit_binned(
-                bins,
-                y,
-                sw,
-                fm,
-                hp,
-                jax.random.fold_in(rng, it),
-                n_trees_cap=cfg.n_estimators,
-                depth_cap=cfg.max_depth,
-                n_bins=n_bins,
+                bins, y, sw, fm, hp, jax.random.fold_in(rng, it), **kw
             )
         total_gain, _ = gain_importances(forest, F)
         imp = np.array(total_gain)  # copy: np.asarray of a jax array is read-only

@@ -19,7 +19,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from cobalt_smart_lender_ai_tpu.models.gbdt import (
     Forest,
     GBDTHyperparams,
+    concat_forest_chunks,
     fit_binned,
+    fit_binned_resumable,
     predict_margin,
 )
 from cobalt_smart_lender_ai_tpu.parallel.mesh import pad_rows
@@ -81,6 +83,83 @@ def fit_binned_dp(
         )
 
     return jax.jit(_fit)(bins, y, sw, fm, hp, rng)
+
+
+def fit_binned_dp_chunked(
+    mesh: Mesh,
+    bins: jax.Array,  # (N, F)
+    y: jax.Array,  # (N,)
+    sample_weight: jax.Array | None,
+    feature_mask: jax.Array | None,
+    hp: GBDTHyperparams,
+    rng: jax.Array,
+    *,
+    n_trees_cap: int,
+    depth_cap: int,
+    n_bins: int,
+    chunk_trees: int,
+    dp_axis: str = "dp",
+) -> Forest:
+    """`fit_binned_dp` split into ``chunk_trees``-round dispatches with the
+    margin carried between them (row-sharded, like the training data) —
+    numerically identical to the one-dispatch fit via the global tree index,
+    exactly as `fit_binned_chunked` is to `fit_binned`. Use when one
+    whole-fit dispatch would outlive the runtime's dispatch tolerance, or
+    when its (larger) program strains the compile service."""
+    if chunk_trees >= n_trees_cap:
+        return fit_binned_dp(
+            mesh, bins, y, sample_weight, feature_mask, hp, rng,
+            n_trees_cap=n_trees_cap, depth_cap=depth_cap, n_bins=n_bins,
+            dp_axis=dp_axis,
+        )
+    N, F = bins.shape
+    sw = jnp.ones((N,), jnp.float32) if sample_weight is None else sample_weight
+    fm = jnp.ones((F,), bool) if feature_mask is None else feature_mask
+    dp = mesh.shape[dp_axis]
+    n_total = N + pad_rows(N, dp)
+    bins = _pad_to(bins, n_total, 0)
+    y = _pad_to(y, n_total, 0)
+    sw = _pad_to(sw.astype(jnp.float32), n_total, 0.0)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(dp_axis),  # carried margin
+            P(),  # global tree offset
+            P(dp_axis, None),  # bins
+            P(dp_axis),  # y
+            P(dp_axis),  # row weights (0 on padding)
+            P(None),  # feature mask
+            P(),  # hp
+            P(),  # rng
+        ),
+        out_specs=(P(), P(dp_axis)),
+        check_vma=False,
+    )
+    def _chunk(m_l, off_l, bins_l, y_l, sw_l, fm_l, hp_l, rng_l):
+        return fit_binned_resumable(
+            bins_l,
+            y_l,
+            sw_l,
+            fm_l,
+            hp_l,
+            rng_l,
+            n_trees_cap=chunk_trees,
+            depth_cap=depth_cap,
+            n_bins=n_bins,
+            axis_name=dp_axis,
+            init_margin=m_l,
+            tree_offset=off_l,
+        )
+
+    runner = jax.jit(_chunk, donate_argnums=(0,))
+    margin = jnp.zeros((n_total,), jnp.float32)
+    chunks = []
+    for off in range(0, n_trees_cap, chunk_trees):
+        forest_c, margin = runner(margin, jnp.int32(off), bins, y, sw, fm, hp, rng)
+        chunks.append(forest_c)
+    return concat_forest_chunks(chunks, n_trees_cap, depth_cap)
 
 
 def predict_margin_dp(

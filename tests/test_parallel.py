@@ -1,6 +1,8 @@
 """Mesh-parallel tests on the 8-device virtual CPU backend: dp-sharded GBDT
 training parity, the CV x HPO fan-out, and RFE feature selection."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -290,3 +292,48 @@ def test_rfecv_scores_and_held_out_auc():
         return roc_auc_score(yte, np.asarray(model.predict_proba(Xte[:, support])[:, 1]))
 
     assert fit_auc(cv.support_) >= fit_auc(plain.support_) - 0.01
+
+
+def test_rfe_chunked_refits_match_single_dispatch():
+    """RFEConfig.chunk_trees routes single-device refits through
+    fit_binned_chunked (margin-carried); the selected features and rankings
+    must be identical to the one-dispatch fit."""
+    rng = np.random.default_rng(4)
+    n = 1500
+    signal = rng.normal(size=(n, 3)).astype(np.float32)
+    noise = rng.normal(size=(n, 7)).astype(np.float32)
+    y = ((signal[:, 0] - signal[:, 1] + signal[:, 2]) > 0).astype(np.int64)
+    X = np.concatenate([signal, noise], axis=1)
+    base = RFEConfig(n_select=3, step=2, n_estimators=12, max_depth=3)
+    plain = rfe_select(X, y, base)
+    chunked = rfe_select(
+        X, y, dataclasses.replace(base, chunk_trees=5)
+    )
+    np.testing.assert_array_equal(plain.support_, chunked.support_)
+    np.testing.assert_array_equal(plain.ranking_, chunked.ranking_)
+
+
+def test_fit_binned_dp_chunked_matches_unchunked(small_binned):
+    """Chunked dp fit (margin carried, row-sharded) must be bit-identical to
+    the one-dispatch dp fit — same global tree indices drive the RNG streams
+    and the n_estimators mask."""
+    from cobalt_smart_lender_ai_tpu.parallel.sharded import (
+        fit_binned_dp,
+        fit_binned_dp_chunked,
+    )
+
+    from cobalt_smart_lender_ai_tpu.parallel.mesh import make_mesh
+
+    bins, y, _ = small_binned
+    mesh = make_mesh(MeshConfig())
+    hp = GBDTHyperparams.from_config(
+        GBDTConfig(n_estimators=8, max_depth=3, n_bins=32)
+    )
+    kw = dict(n_trees_cap=8, depth_cap=3, n_bins=32)
+    rng = jax.random.PRNGKey(9)
+    whole = fit_binned_dp(mesh, bins, y, None, None, hp, rng, **kw)
+    chunked = fit_binned_dp_chunked(
+        mesh, bins, y, None, None, hp, rng, chunk_trees=3, **kw
+    )
+    for a, b in zip(jax.tree.leaves(whole), jax.tree.leaves(chunked)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
