@@ -67,11 +67,6 @@ def _hist_matmul(
 ) -> jax.Array:
     N, F = bins.shape
     K = n_nodes
-    oh_node = jax.nn.one_hot(node_local, K, dtype=jnp.float32)  # (N, K)
-    rhs = jnp.concatenate(
-        [oh_node * g[:, None], oh_node * h[:, None], oh_node * w[:, None]],
-        axis=1,
-    )  # (N, 3K) — stays f32: gradient precision is not traded away
     # Cap the block so the transient one-hot (R, F, B) stays <= 2^27 elements
     # (256MB at bf16) even if XLA fails to fuse it into the contraction;
     # callers can pick smaller blocks via row_block (swept at bench scale:
@@ -80,14 +75,24 @@ def _hist_matmul(
     n_blocks = -(-N // R)
     pad = n_blocks * R - N
     if pad:
-        bins = jnp.pad(bins, ((0, pad), (0, 0)))  # bin 0, but rhs pad is 0
-        rhs = jnp.pad(rhs, ((0, pad), (0, 0)))
+        # Padding: bin 0 rows with zero (g, h, w) contribute nothing.
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        node_local = jnp.pad(node_local, (0, pad))
+        g, h, w = (jnp.pad(v, (0, pad)) for v in (g, h, w))
     bins_b = bins.reshape(n_blocks, R, F)
-    rhs_b = rhs.reshape(n_blocks, R, 3 * K)
+    node_b = node_local.reshape(n_blocks, R)
+    ghw_b = jnp.stack([g, h, w], axis=1).reshape(n_blocks, R, 3)
     iota = jnp.arange(n_bins, dtype=jnp.int32)
 
     def body(acc, xs):
-        bblk, rblk = xs
+        bblk, nblk, ghwblk = xs
+        # The (R, 3K) node-one-hot x channel rhs is built PER BLOCK: doing it
+        # for all N rows up front materializes an O(N*3K) tensor — 8GB at
+        # 1.84M rows x 64 nodes x 12 vmapped jobs, the full-protocol OOM —
+        # while the per-block transient is O(R*3K) and lives only in the
+        # scan step. rhs stays f32: gradient precision is not traded away.
+        oh_node = jax.nn.one_hot(nblk, K, dtype=jnp.float32)  # (R, K)
+        rblk = (oh_node[:, None, :] * ghwblk[:, :, None]).reshape(R, 3 * K)
         # bf16 one-hot: exact 0/1 mask at half the bytes of f32 (3x faster
         # pass measured on v5e); contraction accumulates in f32.
         oh = (bblk.astype(jnp.int32)[:, :, None] == iota[None, None, :]).astype(
@@ -99,7 +104,9 @@ def _hist_matmul(
         return acc, None
 
     acc, _ = jax.lax.scan(
-        body, jnp.zeros((F, n_bins, 3 * K), jnp.float32), (bins_b, rhs_b)
+        body,
+        jnp.zeros((F, n_bins, 3 * K), jnp.float32),
+        (bins_b, node_b, ghw_b),
     )
     return acc.reshape(F, n_bins, 3, K).transpose(3, 0, 1, 2)  # (K, F, B, 3)
 

@@ -35,6 +35,23 @@ def _pad_to(a: jax.Array, n_total: int, fill) -> jax.Array:
     return jnp.pad(a, widths, constant_values=fill)
 
 
+def _prep_dp_rows(mesh, bins, y, sample_weight, feature_mask, dp_axis):
+    """Shared dp preamble: default the weight/mask vectors and zero-weight
+    pad the row axis so it divides the dp mesh axis (bin 0 = missing on the
+    padded rows; their weight is 0 so they are inert either way)."""
+    N, F = bins.shape
+    sw = jnp.ones((N,), jnp.float32) if sample_weight is None else sample_weight
+    fm = jnp.ones((F,), bool) if feature_mask is None else feature_mask
+    n_total = N + pad_rows(N, mesh.shape[dp_axis])
+    return (
+        _pad_to(bins, n_total, 0),
+        _pad_to(y, n_total, 0),
+        _pad_to(sw.astype(jnp.float32), n_total, 0.0),
+        fm,
+        n_total,
+    )
+
+
 def fit_binned_dp(
     mesh: Mesh,
     bins: jax.Array,  # (N, F)
@@ -52,14 +69,9 @@ def fit_binned_dp(
     """Data-parallel `fit_binned`: rows sharded over ``dp_axis``, histograms
     psum-reduced, forest replicated. Rows are zero-weight padded so the row
     count divides the dp axis size."""
-    N, F = bins.shape
-    sw = jnp.ones((N,), jnp.float32) if sample_weight is None else sample_weight
-    fm = jnp.ones((F,), bool) if feature_mask is None else feature_mask
-    dp = mesh.shape[dp_axis]
-    n_total = N + pad_rows(N, dp)
-    bins = _pad_to(bins, n_total, 0)  # bin 0 = missing; weight-0 anyway
-    y = _pad_to(y, n_total, 0)
-    sw = _pad_to(sw.astype(jnp.float32), n_total, 0.0)
+    bins, y, sw, fm, _ = _prep_dp_rows(
+        mesh, bins, y, sample_weight, feature_mask, dp_axis
+    )
 
     @partial(
         jax.shard_map,
@@ -106,20 +118,17 @@ def fit_binned_dp_chunked(
     exactly as `fit_binned_chunked` is to `fit_binned`. Use when one
     whole-fit dispatch would outlive the runtime's dispatch tolerance, or
     when its (larger) program strains the compile service."""
+    if chunk_trees <= 0:
+        raise ValueError(f"chunk_trees must be positive, got {chunk_trees}")
     if chunk_trees >= n_trees_cap:
         return fit_binned_dp(
             mesh, bins, y, sample_weight, feature_mask, hp, rng,
             n_trees_cap=n_trees_cap, depth_cap=depth_cap, n_bins=n_bins,
             dp_axis=dp_axis,
         )
-    N, F = bins.shape
-    sw = jnp.ones((N,), jnp.float32) if sample_weight is None else sample_weight
-    fm = jnp.ones((F,), bool) if feature_mask is None else feature_mask
-    dp = mesh.shape[dp_axis]
-    n_total = N + pad_rows(N, dp)
-    bins = _pad_to(bins, n_total, 0)
-    y = _pad_to(y, n_total, 0)
-    sw = _pad_to(sw.astype(jnp.float32), n_total, 0.0)
+    bins, y, sw, fm, n_total = _prep_dp_rows(
+        mesh, bins, y, sample_weight, feature_mask, dp_axis
+    )
 
     @partial(
         jax.shard_map,
