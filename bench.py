@@ -63,6 +63,7 @@ def run_protocol(n_rows: int, seed: int = 5) -> dict:
     is chunked the same way via the base GBDT config.
     """
     import dataclasses
+    import logging
 
     import jax
 
@@ -72,6 +73,11 @@ def run_protocol(n_rows: int, seed: int = 5) -> dict:
     )
     from cobalt_smart_lender_ai_tpu.pipeline import run_pipeline
 
+    # Stage-progress visibility on stderr: a multi-hour run with a silent
+    # stdout is undebuggable when the tunnel wedges mid-stage.
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s [%(levelname)s] %(message)s"
+    )
     cfg = PipelineConfig(save_intermediate=False)
     cfg = dataclasses.replace(
         cfg,
