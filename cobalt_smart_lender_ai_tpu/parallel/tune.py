@@ -21,6 +21,7 @@ rows), keeping shapes static; validation AUC is the weighted sort-based
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 from functools import partial
 from typing import Any, Mapping, Sequence
@@ -40,6 +41,8 @@ from cobalt_smart_lender_ai_tpu.ops.binning import compute_bin_edges, transform
 from cobalt_smart_lender_ai_tpu.ops.metrics import roc_auc
 from cobalt_smart_lender_ai_tpu.parallel.mesh import make_mesh, pad_rows
 from cobalt_smart_lender_ai_tpu.parallel.sharded import _pad_to, fit_binned_dp
+
+logger = logging.getLogger("cobalt_smart_lender_ai_tpu.tune")
 
 
 def sample_candidates(
@@ -264,7 +267,11 @@ def cross_validate_gbdt(
     # compiles.
     runner = make_runner(schedule[0][1])
     margins = jnp.zeros((n_jobs_padded, n_total), jnp.float32)
-    for off, _k_trees in schedule:
+    # Coarse progress logs (with a blocking sync every ~quarter of the
+    # schedule): a multi-minute silent dispatch loop is undebuggable when a
+    # backend RPC wedges — the last line printed brackets the hang.
+    log_every = max(1, len(schedule) // 4)
+    for i, (off, _k_trees) in enumerate(schedule):
         margins = runner(
             margins,
             jnp.int32(off),
@@ -278,6 +285,16 @@ def cross_validate_gbdt(
             fm,
             rng,
         )  # (n_jobs_padded, n_total), sharded (hp, dp)
+        if len(schedule) > 1 and (i + 1) % log_every == 0:
+            # Scalar fetch, not block_until_ready (which returns immediately
+            # over this tunnel): forces execution up to here, bounding the
+            # in-flight dispatch queue the donated-buffer loop otherwise
+            # builds hundreds deep.
+            np.asarray(margins[:1, :1])
+            logger.info(
+                "cv fan-out: dispatch %d/%d (trees %d..%d) done",
+                i + 1, len(schedule), off, off + _k_trees,
+            )
 
     @jax.jit
     def _score(margins, val_masks_f, w_f, job_fold, y_f):
