@@ -55,12 +55,13 @@ CHUNK_TREES = 100  # keep each dispatch well under the ~60s environment limit
 def run_protocol(n_rows: int, seed: int = 5) -> dict:
     """Time the whole `run_pipeline` protocol on a synthetic raw frame.
 
-    Dispatch budget at full-table scale: the depth-9 search bucket runs 33
-    vmapped (candidate x fold) jobs per dispatch, so `chunk_trees=2` keeps
-    each chunk ~35s on a v5e chip — under the environment's ~60s dispatch
-    tolerance — while the tail-padded schedule still compiles one program
-    per depth bucket. The final refit (up to 300 trees, depth 9, 255 bins)
-    is chunked the same way via the base GBDT config.
+    Dispatch budgets are derived per workload from the cost model in
+    `parallel/budget.py` ("auto"): the search chunks each depth bucket's
+    boosting rounds to ~24s dispatches (at full-table scale the depth-9
+    33-job bucket lands at 1-2 rounds per dispatch, matching the
+    measured-safe round-3 shape; at 130k rows it runs near-whole fits), and
+    the RFE elimination loop advances K whole steps per dispatch with the
+    mask carried on device.
     """
     import dataclasses
     import logging
@@ -81,11 +82,8 @@ def run_protocol(n_rows: int, seed: int = 5) -> dict:
     cfg = PipelineConfig(save_intermediate=False)
     cfg = dataclasses.replace(
         cfg,
-        gbdt=cfg.gbdt.replace(chunk_trees=25),
-        tune=dataclasses.replace(cfg.tune, chunk_trees=2),
-        # Chunked RFE refits: the selector's one-dispatch shard_map compile
-        # at this scale crashes the remote-compile service (reproduced 2x).
-        rfe=dataclasses.replace(cfg.rfe, chunk_trees=25),
+        gbdt=cfg.gbdt.replace(chunk_trees="auto"),
+        tune=dataclasses.replace(cfg.tune, chunk_trees="auto"),
     )
     t0 = time.time()
     raw = synthetic_lendingclub_frame(n_rows=n_rows, seed=seed)

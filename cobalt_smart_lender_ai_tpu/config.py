@@ -55,8 +55,10 @@ class GBDTConfig:
     #: Boosting rounds per XLA dispatch (margins carried between dispatches,
     #: numerically identical — models/gbdt.py `fit_binned_chunked`). Set when
     #: a full fit would outlive the runtime's dispatch tolerance (deep trees x
-    #: millions of rows). None = single dispatch.
-    chunk_trees: int | None = None
+    #: millions of rows). ``"auto"`` derives it from the workload shape
+    #: against the dispatch budget (`parallel/budget.py`). None = single
+    #: dispatch.
+    chunk_trees: int | str | None = None
 
     def replace(self, **kw: Any) -> "GBDTConfig":
         return dataclasses.replace(self, **kw)
@@ -122,8 +124,12 @@ class TuneConfig:
     #: Split each fan-out dispatch into chunks of this many boosting rounds
     #: (margins carried between dispatches; numerically identical). Needed at
     #: full-table scale where one all-jobs x all-trees dispatch would exceed
-    #: the runtime's dispatch-duration tolerance. None = single dispatch.
-    chunk_trees: int | None = None
+    #: the runtime's dispatch-duration tolerance. ``"auto"`` derives the chunk
+    #: per depth bucket from the workload shape against the dispatch budget
+    #: (`parallel/budget.py` — round 3 hardcoded the full-table worst case and
+    #: lost the 130k-row search to a 1-core CPU oracle on host-sync overhead).
+    #: None = single dispatch.
+    chunk_trees: int | str | None = None
     # Search space: model_tree_train_test.py:139-146
     param_space: Mapping[str, Sequence[Any]] = dataclasses.field(
         default_factory=lambda: {
@@ -149,12 +155,23 @@ class RFEConfig:
     max_depth: int = 6
     scale_pos_weight: float = 1.0  # reference passes it to the RFE estimator
     seed: int = 42
-    #: Boosting rounds per dispatch for each selector refit (margins carried,
-    #: numerically identical). On a single-device mesh this routes through
-    #: `fit_binned_chunked`; at full-table scale the one-dispatch shard_map
-    #: fit's compile reliably kills this environment's remote-compile service,
-    #: and the chunked program is the proven-working shape. None = single
-    #: dispatch.
+    #: Whole elimination steps (fit -> gains -> drop) advanced per XLA
+    #: dispatch, with the surviving-feature mask carried ON DEVICE
+    #: (`parallel/rfe.py _advance_elimination`) — bit-identical to stepping on
+    #: host for any value. None = derive from the dispatch-budget cost model
+    #: (`parallel/budget.py`), falling back to the host-stepped loop (0) when
+    #: one selector fit alone outruns the dispatch budget, when
+    #: ``chunk_trees`` is set, or above the compile-risk row threshold
+    #: (budget.COMPILE_RISK_CELLS). 0 = always host-stepped. An explicit
+    #: positive value forces the device-stepped scan with that K (and
+    #: ``chunk_trees`` is then ignored — the scan cannot split one fit
+    #: across dispatches).
+    steps_per_dispatch: int | None = None
+    #: Host-stepped loop only: boosting rounds per dispatch for each selector
+    #: refit (margins carried, numerically identical). With
+    #: ``steps_per_dispatch`` unset, setting this selects the host-stepped
+    #: loop. None = derived from the budget model when the host loop is in
+    #: effect.
     chunk_trees: int | None = None
 
 

@@ -575,9 +575,23 @@ class GBDTClassifier:
         )
         hp = GBDTHyperparams.from_config(cfg)
         key = jax.random.PRNGKey(cfg.seed)
-        if cfg.chunk_trees is not None:
+        chunk = cfg.chunk_trees
+        if chunk is not None:
+            from cobalt_smart_lender_ai_tpu.parallel.budget import (
+                resolve_chunk_trees,
+            )
+
+            chunk = resolve_chunk_trees(
+                chunk,
+                n_trees=cfg.n_estimators,
+                n_rows=N,
+                n_feats=F,
+                n_bins=cfg.n_bins,
+                depth=cfg.max_depth,
+            )
+        if chunk is not None:
             forest = fit_binned_chunked(
-                bins, y, sw, fm, hp, key, chunk_trees=cfg.chunk_trees, **kw
+                bins, y, sw, fm, hp, key, chunk_trees=chunk, **kw
             )
         else:
             forest = fit_binned(bins, y, sw, fm, hp, key, **kw)

@@ -295,6 +295,13 @@ def fit_binary(
         if state == 2:  # diverged: replicate the per-epoch loop's raise
             bad = int(np.flatnonzero(ran_mask)[-1])
             epoch = len(history["loss"]) + bad
+            # Record the epochs that completed earlier in this super-step
+            # before raising, exactly as the per-epoch loop would have (the
+            # diverging epoch itself stays out of history there too).
+            done = np.flatnonzero(ran_mask)[:-1]
+            history["loss"].extend(losses[done].tolist())
+            if has_val:
+                history["val_auc"].extend(aucs[done].tolist())
             raise FloatingPointError(
                 f"epoch {epoch}: training loss is {losses[bad]} — diverged "
                 "(inspect with cobalt_smart_lender_ai_tpu.debug.nan_guard)"

@@ -8,6 +8,19 @@ every refit reuses one compiled XLA program with static shapes — zero
 recompiles across the whole elimination schedule — and each refit's rows can
 shard over the ``dp`` mesh axis.
 
+The elimination loop itself runs ON DEVICE: a `lax.scan` advances K whole
+elimination steps (fit -> gain importances -> stable-rank -> mask update) per
+XLA dispatch, with the surviving-feature mask carried as data. Round 3's
+host-stepped loop paid ~7s of dispatch/host-sync overhead per refit over the
+tunneled backend (708s of a 1409s protocol at 130k rows was RFE); K steps per
+dispatch amortizes that to ~K-fold fewer round trips with bit-identical
+results — the per-step RNG stream keys off the *global* iteration index, and
+the drop rule (stable argsort of masked total-gain, k lowest) is the same
+arithmetic the host loop ran. K is derived from the dispatch-budget cost
+model (`parallel/budget.py`); ``steps_per_dispatch=0`` keeps the legacy
+host-stepped loop (required when one selector fit alone outruns the budget
+and must be chunked *within* the fit via ``chunk_trees``).
+
 ``cv_folds`` adds the reference's exploration-path RFECV
 (`RFECV(min_features_to_select=20, step=5, cv=3, scoring='roc_auc')`,
 notebooks/04_model_training.ipynb cell 13): each elimination step's surviving
@@ -26,11 +39,12 @@ fits, and no per-fold mask divergence to reconcile.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
 from cobalt_smart_lender_ai_tpu.config import GBDTConfig, MeshConfig, RFEConfig
 from cobalt_smart_lender_ai_tpu.models.gbdt import (
@@ -40,7 +54,15 @@ from cobalt_smart_lender_ai_tpu.models.gbdt import (
     gain_importances,
 )
 from cobalt_smart_lender_ai_tpu.ops.binning import compute_bin_edges, transform
+from cobalt_smart_lender_ai_tpu.parallel.budget import (
+    COMPILE_RISK_CELLS,
+    DISPATCH_BUDGET_S,
+    auto_steps_per_dispatch,
+    est_tree_seconds,
+    resolve_chunk_trees,
+)
 from cobalt_smart_lender_ai_tpu.parallel.sharded import (
+    _prep_dp_rows,
     fit_binned_dp,
     fit_binned_dp_chunked,
 )
@@ -58,6 +80,142 @@ class RFEResult:
     #: CV-RFE only: mean validation AUC per surviving feature count, keyed by
     #: n_features — sklearn RFECV's ``cv_results_`` equivalent.
     cv_scores_: dict[int, float] | None = None
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "k", "step", "n_select", "n_trees_cap", "depth_cap", "n_bins",
+        "axis_name",
+    ),
+)
+def _advance_elimination(
+    bins: jax.Array,  # (N, F)
+    y: jax.Array,  # (N,)
+    sw: jax.Array,  # (N,)
+    mask: jax.Array,  # (F,) bool — surviving features
+    ranking: jax.Array,  # (F,) int32
+    next_rank: jax.Array,  # int32 scalar
+    it0: jax.Array,  # int32 scalar — global index of the first step
+    hp: GBDTHyperparams,
+    rng: jax.Array,
+    *,
+    k: int,
+    step: int,
+    n_select: int,
+    n_trees_cap: int,
+    depth_cap: int,
+    n_bins: int,
+    axis_name: str | None = None,
+):
+    """Advance ``k`` whole elimination steps in ONE dispatch: each step refits
+    the selector on the surviving mask, ranks surviving features by total
+    gain (stable ascending, exactly the host loop's
+    ``np.argsort(imp, kind="stable")``), and drops the lowest
+    ``min(step, survivors - n_select)``. Steps past the schedule's end are
+    inert (kdrop == 0), so a fixed ``k`` compiles one program and the tail
+    dispatch just wastes a few discarded fits. RNG streams key off the
+    *global* iteration index ``it0 + i`` — bit-identical to the host loop
+    for any ``k``. Returns the carry plus the (k, F) per-step mask history
+    the CV-scored variant consumes."""
+    F = bins.shape[1]
+
+    def body(carry, i):
+        mask, ranking, next_rank = carry
+        forest = fit_binned(
+            bins, y, sw, mask, hp, jax.random.fold_in(rng, it0 + i),
+            n_trees_cap=n_trees_cap, depth_cap=depth_cap, n_bins=n_bins,
+            axis_name=axis_name,
+        )
+        total_gain, _ = gain_importances(forest, F)
+        imp = jnp.where(mask, total_gain, jnp.inf)
+        n_surv = jnp.sum(mask).astype(jnp.int32)
+        kdrop = jnp.maximum(jnp.minimum(step, n_surv - n_select), 0)
+        order = jnp.argsort(imp, stable=True)
+        rank_pos = jnp.argsort(order, stable=True)  # each feature's rank
+        dropm = (rank_pos < kdrop) & mask
+        mask = mask & ~dropm
+        ranking = jnp.where(dropm, next_rank, ranking)
+        next_rank = next_rank - (kdrop > 0).astype(jnp.int32)
+        return (mask, ranking, next_rank), mask
+
+    (mask, ranking, next_rank), hist = jax.lax.scan(
+        body,
+        (mask, ranking, next_rank),
+        jnp.arange(k, dtype=jnp.int32),
+    )
+    return mask, ranking, next_rank, hist
+
+
+def _eliminate_on_device(
+    bins, y, sw, hp, rng, mesh, dp_axis,
+    *, n_iters, steps_per_dispatch, cfg, n_bins, want_history,
+):
+    """Run the whole elimination schedule as ceil(n_iters / K) dispatches of
+    the K-step program. Returns (mask, ranking, mask_history) as host arrays;
+    history rows are the post-step masks (n_iters, F), only materialized when
+    the CV-scored variant needs them."""
+    F = bins.shape[1]
+    kw = dict(
+        k=steps_per_dispatch,
+        step=cfg.step,
+        n_select=cfg.n_select,
+        n_trees_cap=cfg.n_estimators,
+        depth_cap=cfg.max_depth,
+        n_bins=n_bins,
+    )
+    multi = mesh is not None and mesh.devices.size > 1
+    if multi:
+        bins_p, y_p, sw_p, _, _ = _prep_dp_rows(mesh, bins, y, sw, None, dp_axis)
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(
+                P(dp_axis, None), P(dp_axis), P(dp_axis),  # bins, y, sw
+                P(None), P(None), P(), P(),  # mask, ranking, next_rank, it0
+                P(), P(),  # hp, rng
+            ),
+            out_specs=(P(None), P(None), P(), P(None, None)),
+            check_vma=False,
+        )
+        def _run(bins_l, y_l, sw_l, mask, ranking, next_rank, it0, hp_l, rng_l):
+            return _advance_elimination(
+                bins_l, y_l, sw_l, mask, ranking, next_rank, it0, hp_l, rng_l,
+                axis_name=dp_axis, **kw,
+            )
+
+        runner = jax.jit(_run)
+        args = (bins_p, y_p, sw_p)
+    else:
+        def runner(mask, ranking, next_rank, it0, hp_l, rng_l):
+            return _advance_elimination(
+                bins, y, sw, mask, ranking, next_rank, it0, hp_l, rng_l, **kw
+            )
+
+        args = ()
+
+    mask = jnp.ones((F,), bool)
+    ranking = jnp.ones((F,), jnp.int32)
+    next_rank = jnp.int32(n_iters + 1)
+    history = []
+    for it0 in range(0, n_iters, steps_per_dispatch):
+        if multi:
+            mask, ranking, next_rank, hist = runner(
+                *args, mask, ranking, next_rank, jnp.int32(it0), hp, rng
+            )
+        else:
+            mask, ranking, next_rank, hist = runner(
+                mask, ranking, next_rank, jnp.int32(it0), hp, rng
+            )
+        if want_history:
+            history.append(np.asarray(hist[: n_iters - it0]))
+    mask_np = np.asarray(mask)
+    ranking_np = np.asarray(ranking, dtype=np.int64)
+    hist_np = (
+        np.concatenate(history, axis=0) if history else np.zeros((0, F), bool)
+    )
+    return mask_np, ranking_np, hist_np
 
 
 def rfe_select(
@@ -91,14 +249,117 @@ def rfe_select(
     )
     rng = jax.random.PRNGKey(cfg.seed)
     sw = jnp.ones((N,), jnp.float32)
+    n_iters = max(0, -(-(F - cfg.n_select) // cfg.step))
 
-    score_mask = None
+    # --- elimination-loop strategy. Device-stepped (K whole steps per
+    # dispatch) is the default; the legacy host-stepped loop remains for
+    # `steps_per_dispatch=0`, for explicit `chunk_trees` (a single selector
+    # fit must be split *within* itself), and as the automatic fallback when
+    # the cost model says one fit alone outruns the dispatch budget.
+    steps = cfg.steps_per_dispatch
+    dp_size = 1 if mesh is None else mesh.shape[dp_axis]
+    n_local = -(-N // dp_size)
+    t_fit = (
+        est_tree_seconds(n_local, F, n_bins, cfg.max_depth) * cfg.n_estimators
+    )
+    # Above the compile-risk threshold a whole-fit program's COMPILE (not its
+    # runtime) is the hazard — the K-step scan is a strictly larger program
+    # than the one-dispatch fit that crashed the remote-compile service in
+    # round 3 — so auto selection stays on the proven chunked host loop.
+    compile_risky = n_local * F > COMPILE_RISK_CELLS
+    if steps is None and (
+        cfg.chunk_trees is not None
+        or t_fit > DISPATCH_BUDGET_S
+        or compile_risky
+    ):
+        steps = 0
+    if steps != 0:
+        steps = min(
+            steps or auto_steps_per_dispatch(n_iters, fit_seconds=t_fit),
+            max(n_iters, 1),
+        )
+
+    if steps and n_iters:
+        mask, ranking, hist = _eliminate_on_device(
+            bins, y, sw, hp, rng, mesh, dp_axis,
+            n_iters=n_iters,
+            steps_per_dispatch=steps,
+            cfg=cfg,
+            n_bins=n_bins,
+            want_history=bool(cv_folds),
+        )
+    else:
+        mask = np.ones(F, dtype=bool)
+        ranking = np.ones(F, dtype=np.int64)
+        next_rank = n_iters + 1  # first iteration's drops get the worst rank
+        it = 0
+        chunk = resolve_chunk_trees(
+            cfg.chunk_trees if cfg.chunk_trees is not None else "auto",
+            n_trees=cfg.n_estimators,
+            n_rows=n_local,
+            n_feats=F,
+            n_bins=n_bins,
+            depth=cfg.max_depth,
+        )
+        if chunk is None and compile_risky:
+            # Never compile the one-dispatch whole fit in the compile-risk
+            # regime; 25-round chunks are the round-3 proven shape there.
+            chunk = min(25, cfg.n_estimators)
+        hist_rows = []
+        while mask.sum() > cfg.n_select:
+            fm = jnp.asarray(mask)
+            kw = dict(
+                n_trees_cap=cfg.n_estimators,
+                depth_cap=cfg.max_depth,
+                n_bins=n_bins,
+            )
+            single_device = mesh is None or mesh.devices.size == 1
+            if chunk and single_device:
+                # Chunked refits (margins carried, numerically identical): at
+                # full-table scale the whole-fit program's compile strains this
+                # environment's remote-compile service, while the chunked
+                # resumable program is the bench-proven shape. A 1-device mesh
+                # makes shard_map a no-op, so skip it entirely here.
+                forest = fit_binned_chunked(
+                    bins, y, sw, fm, hp, jax.random.fold_in(rng, it),
+                    chunk_trees=chunk, **kw,
+                )
+            elif chunk and mesh is not None:
+                forest = fit_binned_dp_chunked(
+                    mesh, bins, y, sw, fm, hp, jax.random.fold_in(rng, it),
+                    chunk_trees=chunk, dp_axis=dp_axis, **kw,
+                )
+            elif mesh is not None:
+                forest = fit_binned_dp(
+                    mesh, bins, y, sw, fm, hp, jax.random.fold_in(rng, it),
+                    dp_axis=dp_axis, **kw,
+                )
+            else:
+                forest = fit_binned(
+                    bins, y, sw, fm, hp, jax.random.fold_in(rng, it), **kw
+                )
+            total_gain, _ = gain_importances(forest, F)
+            imp = np.array(total_gain)  # copy: np.asarray of a jax array is read-only
+            imp[~mask] = np.inf  # already-dropped features can't be re-dropped
+            k = int(min(cfg.step, mask.sum() - cfg.n_select))
+            drop = np.argsort(imp, kind="stable")[:k]
+            mask[drop] = False
+            ranking[drop] = next_rank
+            next_rank -= 1
+            it += 1
+            hist_rows.append(mask.copy())
+        hist = (
+            np.stack(hist_rows) if hist_rows else np.zeros((0, F), bool)
+        )
+
     cv_scores: dict[int, float] | None = None
-    cv_masks: dict[int, np.ndarray] = {}
     if cv_folds:
         # Fold scorer: ONE candidate (the selector's own hyperparams) x
         # k folds through the fan-out machinery; masks are traced data, so
-        # every elimination step reuses this single compiled program.
+        # every scored step reuses this single compiled program. Scoring runs
+        # after the whole elimination (scores never influence which feature
+        # drops — they only pick the winning count), so the device-stepped
+        # loop stays dense.
         from cobalt_smart_lender_ai_tpu.parallel.tune import (
             cross_validate_gbdt,
             stratified_kfold_masks,
@@ -114,8 +375,11 @@ def rfe_select(
         hp_stacked = jax.tree.map(lambda a: jnp.stack([a]), hp)
         cv_rng = jax.random.PRNGKey(cfg.seed + 1)
         cv_scores = {}
-
-        def score_mask(fm: np.ndarray) -> None:
+        cv_masks: dict[int, np.ndarray] = {}
+        for fm_np in [np.ones(F, dtype=bool), *hist]:
+            n = int(fm_np.sum())
+            if n in cv_scores:  # F == n_select: initial mask IS the final one
+                continue
             aucs = cross_validate_gbdt(
                 mesh,
                 bins,
@@ -126,63 +390,11 @@ def rfe_select(
                 n_trees_cap=cfg.n_estimators,
                 depth_cap=cfg.max_depth,
                 n_bins=n_bins,
-                feature_mask=jnp.asarray(fm),
+                feature_mask=jnp.asarray(fm_np),
                 dp_axis=dp_axis,
             )
-            n = int(fm.sum())
             cv_scores[n] = float(np.asarray(aucs).mean())
-            cv_masks[n] = fm.copy()
-
-    mask = np.ones(F, dtype=bool)
-    ranking = np.ones(F, dtype=np.int64)
-    n_iters = max(0, -(-(F - cfg.n_select) // cfg.step))
-    next_rank = n_iters + 1  # first iteration's drops get the worst rank
-    it = 0
-    while mask.sum() > cfg.n_select:
-        if score_mask is not None:
-            score_mask(mask)
-        fm = jnp.asarray(mask)
-        kw = dict(
-            n_trees_cap=cfg.n_estimators,
-            depth_cap=cfg.max_depth,
-            n_bins=n_bins,
-        )
-        single_device = mesh is None or mesh.devices.size == 1
-        if cfg.chunk_trees and single_device:
-            # Chunked refits (margins carried, numerically identical): at
-            # full-table scale the whole-fit program's compile strains this
-            # environment's remote-compile service, while the chunked
-            # resumable program is the bench-proven shape. A 1-device mesh
-            # makes shard_map a no-op, so skip it entirely here.
-            forest = fit_binned_chunked(
-                bins, y, sw, fm, hp, jax.random.fold_in(rng, it),
-                chunk_trees=cfg.chunk_trees, **kw,
-            )
-        elif cfg.chunk_trees and mesh is not None:
-            forest = fit_binned_dp_chunked(
-                mesh, bins, y, sw, fm, hp, jax.random.fold_in(rng, it),
-                chunk_trees=cfg.chunk_trees, dp_axis=dp_axis, **kw,
-            )
-        elif mesh is not None:
-            forest = fit_binned_dp(
-                mesh, bins, y, sw, fm, hp, jax.random.fold_in(rng, it),
-                dp_axis=dp_axis, **kw,
-            )
-        else:
-            forest = fit_binned(
-                bins, y, sw, fm, hp, jax.random.fold_in(rng, it), **kw
-            )
-        total_gain, _ = gain_importances(forest, F)
-        imp = np.array(total_gain)  # copy: np.asarray of a jax array is read-only
-        imp[~mask] = np.inf  # already-dropped features can't be re-dropped
-        k = int(min(cfg.step, mask.sum() - cfg.n_select))
-        drop = np.argsort(imp, kind="stable")[:k]
-        mask[drop] = False
-        ranking[drop] = next_rank
-        next_rank -= 1
-        it += 1
-    if score_mask is not None:
-        score_mask(mask)  # the final n_select-feature mask
+            cv_masks[n] = fm_np.copy()
         # Best mean val AUC wins; ties prefer fewer features (sklearn RFECV's
         # scan order over ascending feature counts).
         best_n = min(cv_scores, key=lambda n: (-cv_scores[n], n))

@@ -112,6 +112,21 @@ def stratified_kfold_masks(y: np.ndarray, k: int, seed: int) -> np.ndarray:
     return np.stack([fold_of == f for f in range(k)])
 
 
+def depth_buckets(
+    candidates: Sequence[Mapping[str, Any]], base: GBDTConfig
+) -> list[list[int]]:
+    """Candidate indices bucketed by resolved ``max_depth``, ascending — the
+    dispatch grouping of `randomized_search` (the complete-tree tensors are
+    sized by the structural depth cap, so one depth-9 candidate in a joint
+    batch would force 512-leaf tensors on every vmapped job). Shared with
+    `tools/protocol_stages.py` so staged runs can never drift from the joint
+    dispatch's bucketing."""
+    by_depth: dict[int, list[int]] = {}
+    for i, cand in enumerate(candidates):
+        by_depth.setdefault(base.replace(**dict(cand)).max_depth, []).append(i)
+    return [by_depth[d] for d in sorted(by_depth)]
+
+
 @dataclasses.dataclass
 class SearchResult:
     """Mirror of the `RandomizedSearchCV` attributes the reference reads
@@ -139,14 +154,18 @@ def cross_validate_gbdt(
     hp_axis: str = "hp",
     dp_axis: str = "dp",
     cand_ids: jax.Array | None = None,
-    chunk_trees: int | None = None,
+    chunk_trees: int | str | None = None,
 ) -> jax.Array:
     """Validation ROC-AUC for every (candidate, fold) job, shape ``(C, K)``.
 
     ``chunk_trees`` splits the boosting rounds across multiple dispatches
     (margins carried between them, numerically identical — see the runner
     below); use it when n_jobs x n_trees x rows would make one dispatch run
-    longer than the environment tolerates.
+    longer than the environment tolerates. ``"auto"`` derives the chunk from
+    THIS call's workload shape (local rows x local jobs x depth_cap x bins)
+    against the dispatch budget (`parallel/budget.py`), so a 130k-row bucket
+    runs near-whole fits per dispatch while the 2.3M-row bucket still chunks
+    small.
 
     Jobs shard over the ``hp`` mesh axis (padded to a multiple of its size);
     rows shard over ``dp``. One compiled program covers every job.
@@ -189,6 +208,20 @@ def cross_validate_gbdt(
     # padded rows with weight 1). Row validity and the caller's sample_weight
     # ride the same vector.
     dp_size = mesh.shape[dp_axis]
+    if chunk_trees is not None:
+        from cobalt_smart_lender_ai_tpu.parallel.budget import (
+            resolve_chunk_trees,
+        )
+
+        chunk_trees = resolve_chunk_trees(
+            chunk_trees,
+            n_trees=n_trees_cap,
+            n_rows=-(-N // dp_size),
+            n_feats=F,
+            n_bins=n_bins,
+            depth=depth_cap,
+            n_jobs=n_jobs_padded // hp_size,
+        )
     n_total = N + pad_rows(N, dp_size)
     bins_p = _pad_to(bins, n_total, 0)
     y_p = _pad_to(y, n_total, 0)
@@ -332,19 +365,13 @@ def randomized_search(
     )
     fm = None if feature_mask is None else jnp.asarray(feature_mask, bool)
 
-    # Bucket candidates by their resolved max_depth: the complete-tree
-    # tensors are sized by the *structural* depth_cap, so one depth-9
-    # candidate in a joint batch would force 512-leaf trees on every vmapped
-    # job. Per-bucket dispatches keep each job's tree tensor at its own
-    # depth. Scores are unchanged by bucketing: AUC is invariant to the cap
-    # (levels beyond a candidate's traced max_depth are forced trivial), and
-    # passing the candidates' *global* indices as cand_ids keeps every job's
-    # RNG stream identical to the joint dispatch's.
-    by_depth: dict[int, list[int]] = {}
-    for i, cand in enumerate(candidates):
-        by_depth.setdefault(base.replace(**dict(cand)).max_depth, []).append(i)
+    # Per-bucket dispatches keep each job's tree tensor at its own depth
+    # (see `depth_buckets`). Scores are unchanged by bucketing: AUC is
+    # invariant to the cap (levels beyond a candidate's traced max_depth are
+    # forced trivial), and passing the candidates' *global* indices as
+    # cand_ids keeps every job's RNG stream identical to the joint dispatch's.
     split_scores = np.zeros((len(candidates), tune.cv_folds))
-    for _, idxs in sorted(by_depth.items()):
+    for idxs in depth_buckets(candidates, base):
         hps, n_trees_cap, depth_cap = stack_candidates(
             [candidates[i] for i in idxs], base
         )
@@ -385,6 +412,7 @@ __all__ = [
     "sample_candidates",
     "stack_candidates",
     "stratified_kfold_masks",
+    "depth_buckets",
     "cross_validate_gbdt",
     "randomized_search",
     "SearchResult",

@@ -267,7 +267,7 @@ def main(argv=None) -> PipelineResult:
             tune=TuneConfig(
                 n_iter=4,
                 cv_folds=2,
-                chunk_trees=100,
+                chunk_trees="auto",
                 param_space={
                     "n_estimators": (150, 300),
                     "max_depth": (3,),

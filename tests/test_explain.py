@@ -11,7 +11,11 @@ from sklearn.datasets import make_classification
 
 from cobalt_smart_lender_ai_tpu.explain import TreeExplainer
 from cobalt_smart_lender_ai_tpu.explain.treeshap import shap_values
-from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
+from cobalt_smart_lender_ai_tpu.models.gbdt import (
+    Forest,
+    GBDTClassifier,
+    predict_margin,
+)
 
 
 @pytest.fixture(scope="module")
@@ -117,6 +121,59 @@ def test_depth9_exact_and_bounded():
     )
     bf = _brute_force_phi(model.forest, X[3], 6, 8)
     np.testing.assert_allclose(np.asarray(phis)[3], bf, atol=1e-3)
+
+
+def test_serving_shape_bounded_and_additive():
+    """The shape a tuned depth-9 artifact would actually ship — 300 trees x
+    depth 9 x the 20-feature serving contract — run through the bulk
+    explainer at its serving chunk size: additivity must hold and a chunk
+    must clear in interactive time (the O(L*d^3) math says ~tens of ms/row;
+    the bound is generous for the 1-core CI box). The forest is synthesized
+    structurally (consistent parent/child covers) rather than trained: the
+    algorithm's exactness is pinned by the brute-force tests above; this
+    test pins time/memory at the artifact shape `cobalt_fast_api.py:100`
+    serves per request."""
+    import time
+
+    T, depth, F = 300, 9, 20
+    n_internal = 2**depth - 1
+    n_leaves = 2**depth
+    rng = np.random.default_rng(0)
+    cover = np.zeros((T, n_internal + n_leaves), np.float32)
+    cover[:, 0] = 100_000.0
+    ratios = rng.uniform(0.2, 0.8, size=(T, n_internal)).astype(np.float32)
+    for i in range(n_internal):
+        cover[:, 2 * i + 1] = cover[:, i] * ratios[:, i]
+        cover[:, 2 * i + 2] = cover[:, i] * (1.0 - ratios[:, i])
+    forest = Forest(
+        feature=jnp.asarray(rng.integers(0, F, size=(T, n_internal)), jnp.int32),
+        thr_bin=jnp.zeros((T, n_internal), jnp.int32),
+        thr_float=jnp.asarray(
+            rng.normal(size=(T, n_internal)), jnp.float32
+        ),
+        missing_left=jnp.asarray(rng.random((T, n_internal)) < 0.5),
+        gain=jnp.ones((T, n_internal), jnp.float32),
+        cover=jnp.asarray(cover),
+        leaf_value=jnp.asarray(
+            rng.normal(scale=0.01, size=(T, n_leaves)), jnp.float32
+        ),
+        depth=depth,
+    )
+    X = rng.normal(size=(64, F)).astype(np.float32)
+    X[rng.random(X.shape) < 0.02] = np.nan
+
+    phis, base = shap_values(forest, jnp.asarray(X), n_features=F)  # warmup
+    t0 = time.time()
+    phis, base = shap_values(forest, jnp.asarray(X), n_features=F)
+    phis = np.asarray(phis)
+    elapsed = time.time() - t0
+    margins = np.asarray(predict_margin(forest, jnp.asarray(X)))
+    np.testing.assert_allclose(
+        float(base) + phis.sum(axis=1), margins, atol=1e-3
+    )
+    assert phis.shape == (64, F) and np.isfinite(phis).all()
+    # Interactive bound: a 64-row serving chunk at the full artifact shape.
+    assert elapsed < 60.0, f"serving-shape SHAP chunk took {elapsed:.1f}s"
 
 
 def test_explainer_facade(small_model):

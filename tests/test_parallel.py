@@ -294,6 +294,96 @@ def test_rfecv_scores_and_held_out_auc():
     assert fit_auc(cv.support_) >= fit_auc(plain.support_) - 0.01
 
 
+def test_budget_auto_chunk_derivation():
+    """The dispatch-budget model must reproduce the three calibration points'
+    safe chunk sizes: whole fits for tiny work, the measured-safe 1-2 rounds
+    at the full-table depth-9 bucket, and well past round 3's hardcoded 12
+    (but under the crashed 50) for the same bucket at 130k rows."""
+    from cobalt_smart_lender_ai_tpu.parallel.budget import (
+        DISPATCH_BUDGET_S,
+        auto_chunk_trees,
+        est_tree_seconds,
+        resolve_chunk_trees,
+    )
+
+    assert (
+        auto_chunk_trees(300, n_rows=2000, n_feats=12, n_bins=64, depth=3)
+        is None
+    )
+    big = auto_chunk_trees(
+        300, n_rows=2_300_000, n_feats=20, n_bins=255, depth=9, n_jobs=33
+    )
+    assert 1 <= big <= 3
+    mid = auto_chunk_trees(
+        300, n_rows=130_000, n_feats=20, n_bins=255, depth=9, n_jobs=33
+    )
+    assert 15 <= mid <= 45
+    # Estimated dispatch wall respects the budget (and so the ~60s kill).
+    assert (
+        est_tree_seconds(130_000, 20, 255, 9, 33) * mid
+        <= DISPATCH_BUDGET_S + 1.0
+    )
+    shape = dict(n_trees=300, n_rows=10, n_feats=2, n_bins=4, depth=2)
+    assert resolve_chunk_trees(7, **shape) == 7
+    assert resolve_chunk_trees(None, **shape) is None
+    assert resolve_chunk_trees("auto", **shape) is None  # tiny => one dispatch
+
+
+def test_rfe_device_steps_match_host_loop():
+    """The on-device K-step elimination (round-4 default) must reproduce the
+    host-stepped loop exactly — same support, same ranking — for K covering
+    the whole schedule, for K=2 (multi-dispatch, inert tail steps), and on a
+    multi-device mesh."""
+    rng = np.random.default_rng(9)
+    n = 1800
+    signal = rng.normal(size=(n, 3)).astype(np.float32)
+    noise = rng.normal(size=(n, 8)).astype(np.float32)
+    y = ((signal[:, 0] + signal[:, 1] - signal[:, 2]) > 0).astype(np.int64)
+    X = np.concatenate([signal, noise], axis=1)
+    base = RFEConfig(n_select=3, step=2, n_estimators=12, max_depth=3)
+
+    host = rfe_select(X, y, dataclasses.replace(base, steps_per_dispatch=0))
+    dev = rfe_select(X, y, base)  # auto K: whole schedule, one dispatch
+    np.testing.assert_array_equal(host.support_, dev.support_)
+    np.testing.assert_array_equal(host.ranking_, dev.ranking_)
+
+    dev2 = rfe_select(X, y, dataclasses.replace(base, steps_per_dispatch=2))
+    np.testing.assert_array_equal(host.support_, dev2.support_)
+    np.testing.assert_array_equal(host.ranking_, dev2.ranking_)
+
+    mesh = make_mesh(MeshConfig())
+    host_m = rfe_select(
+        X, y, dataclasses.replace(base, steps_per_dispatch=0), mesh=mesh
+    )
+    dev_m = rfe_select(X, y, base, mesh=mesh)
+    np.testing.assert_array_equal(host_m.support_, dev_m.support_)
+    np.testing.assert_array_equal(host_m.ranking_, dev_m.ranking_)
+
+
+def test_rfecv_device_steps_match_host_loop():
+    """CV-scored elimination through the device-stepped loop: the per-count
+    scores and the winning support must match the host-stepped run (scoring
+    never influences which feature drops, only which count wins)."""
+    rng = np.random.default_rng(3)
+    n = 1500
+    signal = rng.normal(size=(n, 3)).astype(np.float32)
+    noise = rng.normal(size=(n, 6)).astype(np.float32)
+    y = ((signal[:, 0] - signal[:, 1] + 0.5 * signal[:, 2]) > 0).astype(
+        np.int64
+    )
+    X = np.concatenate([signal, noise], axis=1)
+    base = RFEConfig(n_select=2, step=3, n_estimators=10, max_depth=3)
+    host = rfe_select(
+        X, y, dataclasses.replace(base, steps_per_dispatch=0), cv_folds=2
+    )
+    dev = rfe_select(X, y, base, cv_folds=2)
+    assert host.cv_scores_ is not None and dev.cv_scores_ is not None
+    assert set(host.cv_scores_) == set(dev.cv_scores_)
+    for k in host.cv_scores_:
+        assert host.cv_scores_[k] == pytest.approx(dev.cv_scores_[k], abs=1e-6)
+    np.testing.assert_array_equal(host.support_, dev.support_)
+
+
 def test_rfe_chunked_refits_match_single_dispatch():
     """RFEConfig.chunk_trees routes single-device refits through
     fit_binned_chunked (margin-carried); the selected features and rankings

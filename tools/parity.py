@@ -88,7 +88,7 @@ def build_matrices(n_rows: int, seed: int):
     }
 
 
-def run_ours(mats, chunk_trees: int | None = 12) -> dict:
+def run_ours(mats, chunk_trees: int | str | None = "auto") -> dict:
     """This framework's protocol on the shared matrices — the L3 block of
     pipeline.run_pipeline, run directly so both sides consume the same
     arrays."""
@@ -231,7 +231,9 @@ def run_oracle(mats, seed: int = 22) -> dict:
     }
 
 
-def run_head_to_head(n_rows: int, seed: int = 11, chunk_trees: int | None = 12):
+def run_head_to_head(
+    n_rows: int, seed: int = 11, chunk_trees: int | str | None = "auto"
+):
     """Both sides in one process (used by the slow-marked test, where the
     conftest pins everything to the virtual CPU mesh)."""
     mats = build_matrices(n_rows, seed)
@@ -260,10 +262,15 @@ def main(argv=None):
     ap.add_argument("inputs", nargs="*", help="json files for merge")
     ap.add_argument("--rows", type=int, default=130_000)
     ap.add_argument("--seed", type=int, default=11)
-    # Dispatch budget: the depth-9 search bucket runs 33 vmapped jobs per
-    # dispatch; 50-tree chunks at 130k rows crashed the tunneled TPU worker
-    # (dispatch past the environment's ~60s tolerance), 12 stays well under.
-    ap.add_argument("--chunk-trees", type=int, default=12)
+    # Dispatch budget: "auto" derives per-bucket chunks from the workload
+    # shape (parallel/budget.py) — at 130k rows the depth-9 33-job bucket
+    # lands near 24 rounds/dispatch (50-tree chunks crashed the tunneled TPU
+    # worker once; 12 was the safe hardcode auto replaces). An int pins it.
+    ap.add_argument(
+        "--chunk-trees",
+        default="auto",
+        type=lambda s: s if s == "auto" else (None if s == "none" else int(s)),
+    )
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 

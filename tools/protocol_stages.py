@@ -19,13 +19,14 @@ the one BENCH_PROTOCOL.json shape `bench.py` embeds.
 
 Usage (each stage is one process; rerun any stage that wedges):
 
+    python tools/protocol_stages.py stages                     # list search stages
     python tools/protocol_stages.py prep    --rows 2300000 --dir /tmp/proto
-    python tools/protocol_stages.py search0 --dir /tmp/proto   # depth-3 bucket
-    python tools/protocol_stages.py search1 --dir /tmp/proto   # depth-5
-    python tools/protocol_stages.py search2 --dir /tmp/proto   # depth-7
-    python tools/protocol_stages.py search3 --dir /tmp/proto   # depth-9 (1st half)
-    python tools/protocol_stages.py search4 --dir /tmp/proto   # depth-9 (2nd half)
+    python tools/protocol_stages.py search0 --dir /tmp/proto   # ... searchN-1
     python tools/protocol_stages.py final   --dir /tmp/proto --out BENCH_PROTOCOL.json
+
+The stage count is derived at runtime from the candidate sample through
+`parallel.tune.depth_buckets` (the `stages` subcommand prints it), so it can
+never drift from `randomized_search`'s joint-dispatch bucketing.
 """
 
 from __future__ import annotations
@@ -42,19 +43,22 @@ import sys
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from bench import NORTH_STAR_ROWS_PER_SEC_PER_CHIP  # single source of truth
 
-CHUNK_TREES = 2  # search dispatch budget (see bench.run_protocol)
-FIT_CHUNK_TREES = 25  # final refit / RFE dispatch budget
+#: Per-dispatch boosting-round chunks are derived from each stage's workload
+#: shape against the dispatch budget (parallel/budget.py) — round 3's
+#: hardcoded worst-case chunk of 2 made small runs host-sync-bound.
+CHUNK_TREES = "auto"
 
 
-def _buckets(candidates):
-    """Depth buckets in randomized_search's dispatch order, with the depth-9
-    bucket split in two so no stage runs >~30 min on this backend."""
-    by_depth: dict[int, list[int]] = {}
-    for i, c in enumerate(candidates):
-        by_depth.setdefault(c["max_depth"], []).append(i)
+def _buckets(candidates, base):
+    """Search stages: `parallel.tune.depth_buckets`' EXACT bucketing (shared
+    helper, so stage indices can never drift from the joint dispatch's), with
+    any bucket of >6 candidates split in two so no stage runs >~30 min on
+    this backend. Scores stay identical to the joint dispatch either way via
+    global cand_ids."""
+    from cobalt_smart_lender_ai_tpu.parallel.tune import depth_buckets
+
     stages = []
-    for d in sorted(by_depth):
-        idxs = by_depth[d]
+    for idxs in depth_buckets(candidates, base):
         if len(idxs) > 6:
             stages.append(idxs[: len(idxs) // 2])
             stages.append(idxs[len(idxs) // 2:])
@@ -109,9 +113,9 @@ def stage_prep(args):
     timings["split"] = round(time.time() - t0, 1)
 
     t0 = time.time()
-    rfe_cfg = dataclasses.replace(
-        RFEConfig(), scale_pos_weight=spw, chunk_trees=FIT_CHUNK_TREES
-    )
+    # Device-stepped elimination (K steps per dispatch, auto-derived) — the
+    # default RFEConfig path since round 4.
+    rfe_cfg = dataclasses.replace(RFEConfig(), scale_pos_weight=spw)
     rfe = rfe_select(X_train, y_train, rfe_cfg, mesh=make_mesh())
     timings["rfe"] = round(time.time() - t0, 1)
     selected = [n for n, k in zip(ff.feature_names, rfe.support_) if k]
@@ -178,7 +182,7 @@ def stage_search(args, stage_idx: int):
     t_wall0 = time.time()
     z, meta = _load_prep(args.dir)
     tune, base, candidates = _search_setup(meta)
-    idxs = _buckets(candidates)[stage_idx]
+    idxs = _buckets(candidates, base)[stage_idx]
 
     X = jnp.asarray(z["Xtr"])
     y_np = z["y_train"]
@@ -222,7 +226,7 @@ def stage_final(args):
     t_wall0 = time.time()
     z, meta = _load_prep(args.dir)
     tune, base, candidates = _search_setup(meta)
-    n_stages = len(_buckets(candidates))
+    n_stages = len(_buckets(candidates, base))
     scores = np.zeros((len(candidates), tune.cv_folds))
     search_seconds = 0.0
     for i in range(n_stages):
@@ -233,9 +237,7 @@ def stage_final(args):
     best_i = int(mean_auc.argmax())
     best = dict(candidates[best_i])
 
-    est = GBDTClassifier(
-        base.replace(**best, chunk_trees=FIT_CHUNK_TREES)
-    )
+    est = GBDTClassifier(base.replace(**best, chunk_trees="auto"))
     est.fit(z["Xtr"], z["y_train"])
     margin = est.predict_margin(jnp.asarray(z["Xte"]))
     test_auc = float(roc_auc(jnp.asarray(z["y_test"], jnp.float32), margin))
@@ -274,13 +276,40 @@ def stage_final(args):
         Path(args.out).write_text(json.dumps(doc, indent=2))
 
 
+def stage_list():
+    """Print the runtime-derived search-stage layout (no accelerator work)."""
+    from cobalt_smart_lender_ai_tpu.config import GBDTConfig, TuneConfig
+    from cobalt_smart_lender_ai_tpu.parallel.tune import sample_candidates
+
+    tune = TuneConfig()
+    base = GBDTConfig()
+    candidates = sample_candidates(tune.param_space, tune.n_iter, tune.seed)
+    stages = _buckets(candidates, base)
+    print(
+        json.dumps(
+            {
+                "n_stages": len(stages),
+                "stages": [
+                    {
+                        "stage": f"search{i}",
+                        "cand_idxs": idxs,
+                        "depths": sorted(
+                            {candidates[j]["max_depth"] for j in idxs}
+                        ),
+                    }
+                    for i, idxs in enumerate(stages)
+                ],
+            }
+        )
+    )
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "stage",
-        help="'prep', 'final', or 'search<N>' — N in range(n_stages), where "
-        "n_stages is computed from the candidate sample at runtime "
-        "(today: 5)",
+        help="'prep', 'final', 'stages' (list the runtime-derived search "
+        "stages), or 'search<N>' — N in range(n_stages) per 'stages'",
     )
     ap.add_argument("--rows", type=int, default=2_300_000)
     ap.add_argument("--dir", default="/tmp/proto_bench")
@@ -294,6 +323,8 @@ def main(argv=None):
     )
     if args.stage == "prep":
         stage_prep(args)
+    elif args.stage == "stages":
+        stage_list()
     elif args.stage.startswith("search") and args.stage[len("search"):].isdigit():
         stage_search(args, int(args.stage[len("search"):]))
     elif args.stage == "final":
