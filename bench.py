@@ -59,9 +59,11 @@ def run_protocol(n_rows: int, seed: int = 5) -> dict:
     `parallel/budget.py` ("auto"): the search chunks each depth bucket's
     boosting rounds to ~24s dispatches (at full-table scale the depth-9
     33-job bucket lands at 1-2 rounds per dispatch, matching the
-    measured-safe round-3 shape; at 130k rows it runs near-whole fits), and
-    the RFE elimination loop advances K whole steps per dispatch with the
-    mask carried on device.
+    measured-safe round-3 shape; at 130k rows it runs near-whole fits). The
+    RFE elimination loop advances K whole steps per dispatch with the mask
+    carried on device at sub-compile-risk scales; above
+    budget.COMPILE_RISK_CELLS (the full-table case) it stays on the proven
+    chunked host-stepped loop.
     """
     import dataclasses
     import logging

@@ -33,11 +33,17 @@ dominates deep trees at small N. Calibration from four measured v5e points:
       >= 0.5 s/tree        (a purely N-linear model derived a 121-tree chunk
                             here and crashed the worker — round-4 session;
                             the fixed term is fit to this boundary + margin)
+    - depth-9 bucket, 33 jobs, 130k, measured directly (round-4 micro):
+      1.47 s/tree direct / 1.27 s/tree with sibling subtraction — pinning
+      B_NODE and C_FIX to within a few percent at this shape, and showing
+      subtraction's realized saving is ~25% (mask multiplies + the
+      stack/subtract step eat part of the halved contraction), hence the
+      0.75 effective-width factor rather than 0.5.
 
-A_LEVEL ~ 1e-12, B_NODE ~ 7e-14 (s per row*feat*bin), C_FIX ~ 4e-9 (s per
-job*feat*bin*node) reproduce all four within ~30%, erring high at small N.
-The budget is 24 s — a 2.5x margin under the 60 s kill, absorbing the
-model's error band.
+A_LEVEL ~ 1e-12, B_NODE ~ 7e-14 (s per row*feat*bin), C_FIX ~ 5.9e-9 (s per
+job*feat*bin*node) reproduce every point within ~10% except the subtract
+path (~10% optimistic). The budget is 24 s — a 2.5x margin under the 60 s
+kill, absorbing the model's error band.
 """
 
 from __future__ import annotations
@@ -53,7 +59,7 @@ A_LEVEL = 1.0e-12
 B_NODE = 7.0e-14
 #: s per job*feat*bin per tree node, independent of N (per-block accumulator
 #: traffic) — the term that keeps small-N deep-tree chunks honest.
-C_FIX = 4.0e-9
+C_FIX = 5.9e-9
 
 #: rows x features above which a single whole-fit XLA program's COMPILE (not
 #: its runtime) is the hazard: at full-table scale (2.3M x 116 ~ 267M cells)
@@ -75,10 +81,20 @@ def est_tree_seconds(
     n_bins: int,
     depth: int,
     n_jobs: int = 1,
+    *,
+    hist_subtract: bool = False,
 ) -> float:
     """Estimated seconds for ONE boosting round across ``n_jobs`` vmapped
-    jobs of ``n_rows`` x ``n_feats`` binned data at ``n_bins`` bins."""
+    jobs of ``n_rows`` x ``n_feats`` binned data at ``n_bins`` bins.
+
+    ``hist_subtract`` mirrors `models/gbdt.py`'s sibling-subtraction fast
+    path (single-device row axis): only left children are contracted. The
+    ideal width halving realizes as ~25% measured (see module docstring), so
+    the effective node width is 0.75x. Default False = the conservative
+    direct-histogram cost, also correct for dp>1 fits."""
     n_nodes = 2.0**depth - 1.0
+    if hist_subtract:
+        n_nodes *= 0.75
     linear = n_rows * (A_LEVEL * depth + B_NODE * n_nodes)
     fixed = C_FIX * n_nodes
     return n_jobs * n_feats * n_bins * (linear + fixed)
@@ -93,10 +109,13 @@ def auto_chunk_trees(
     depth: int,
     n_jobs: int = 1,
     budget_s: float = DISPATCH_BUDGET_S,
+    hist_subtract: bool = False,
 ) -> int | None:
     """Boosting rounds per dispatch for an ``n_trees``-round fit, or ``None``
     when the whole fit fits one dispatch (no chunking machinery needed)."""
-    t_tree = est_tree_seconds(n_rows, n_feats, n_bins, depth, n_jobs)
+    t_tree = est_tree_seconds(
+        n_rows, n_feats, n_bins, depth, n_jobs, hist_subtract=hist_subtract
+    )
     if t_tree * n_trees <= budget_s:
         return None
     return max(1, int(budget_s / max(t_tree, 1e-12)))
@@ -112,6 +131,7 @@ def resolve_chunk_trees(
     depth: int,
     n_jobs: int = 1,
     budget_s: float = DISPATCH_BUDGET_S,
+    hist_subtract: bool = False,
 ) -> int | None:
     """Map a config's ``chunk_trees`` (int, ``None``, or ``"auto"``) to the
     concrete int-or-None the fit loops consume."""
@@ -124,6 +144,7 @@ def resolve_chunk_trees(
             depth=depth,
             n_jobs=n_jobs,
             budget_s=budget_s,
+            hist_subtract=hist_subtract,
         )
     if isinstance(chunk_trees, str):
         # Fail at the config boundary, not deep inside a fit loop.

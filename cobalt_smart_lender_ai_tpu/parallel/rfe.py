@@ -86,7 +86,7 @@ class RFEResult:
     jax.jit,
     static_argnames=(
         "k", "step", "n_select", "n_trees_cap", "depth_cap", "n_bins",
-        "axis_name",
+        "axis_name", "hist_subtract",
     ),
 )
 def _advance_elimination(
@@ -107,6 +107,7 @@ def _advance_elimination(
     depth_cap: int,
     n_bins: int,
     axis_name: str | None = None,
+    hist_subtract: bool = True,
 ):
     """Advance ``k`` whole elimination steps in ONE dispatch: each step refits
     the selector on the surviving mask, ranks surviving features by total
@@ -125,7 +126,7 @@ def _advance_elimination(
         forest = fit_binned(
             bins, y, sw, mask, hp, jax.random.fold_in(rng, it0 + i),
             n_trees_cap=n_trees_cap, depth_cap=depth_cap, n_bins=n_bins,
-            axis_name=axis_name,
+            axis_name=axis_name, hist_subtract=hist_subtract,
         )
         total_gain, _ = gain_importances(forest, F)
         imp = jnp.where(mask, total_gain, jnp.inf)
@@ -182,7 +183,12 @@ def _eliminate_on_device(
         def _run(bins_l, y_l, sw_l, mask, ranking, next_rank, it0, hp_l, rng_l):
             return _advance_elimination(
                 bins_l, y_l, sw_l, mask, ranking, next_rank, it0, hp_l, rng_l,
-                axis_name=dp_axis, **kw,
+                axis_name=dp_axis,
+                # dp>1: direct histograms keep the device-stepped loop
+                # bit-identical to the host loop's dp fits (see
+                # sharded.fit_binned_dp).
+                hist_subtract=mesh.shape[dp_axis] == 1,
+                **kw,
             )
 
         runner = jax.jit(_run)
@@ -260,7 +266,10 @@ def rfe_select(
     dp_size = 1 if mesh is None else mesh.shape[dp_axis]
     n_local = -(-N // dp_size)
     t_fit = (
-        est_tree_seconds(n_local, F, n_bins, cfg.max_depth) * cfg.n_estimators
+        est_tree_seconds(
+            n_local, F, n_bins, cfg.max_depth, hist_subtract=dp_size == 1
+        )
+        * cfg.n_estimators
     )
     # Above the compile-risk threshold a whole-fit program's COMPILE (not its
     # runtime) is the hazard — the K-step scan is a strictly larger program
@@ -300,6 +309,7 @@ def rfe_select(
             n_feats=F,
             n_bins=n_bins,
             depth=cfg.max_depth,
+            hist_subtract=dp_size == 1,
         )
         if chunk is None and compile_risky:
             # Never compile the one-dispatch whole fit in the compile-risk

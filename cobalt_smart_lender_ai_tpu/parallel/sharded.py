@@ -92,6 +92,11 @@ def fit_binned_dp(
             depth_cap=depth_cap,
             n_bins=n_bins,
             axis_name=dp_axis,
+            # Sibling subtraction only when the row axis is unsharded: with
+            # >1 device, psum reduction order + subtraction would flip
+            # near-tie splits vs a single device, breaking the dp
+            # bit-identity guarantee this module advertises.
+            hist_subtract=mesh.shape[dp_axis] == 1,
         )
 
     return jax.jit(_fit)(bins, y, sw, fm, hp, rng)
@@ -160,6 +165,7 @@ def fit_binned_dp_chunked(
             axis_name=dp_axis,
             init_margin=m_l,
             tree_offset=off_l,
+            hist_subtract=mesh.shape[dp_axis] == 1,  # see fit_binned_dp
         )
 
     runner = jax.jit(_chunk, donate_argnums=(0,))

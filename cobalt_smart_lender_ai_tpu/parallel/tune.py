@@ -221,6 +221,7 @@ def cross_validate_gbdt(
             n_bins=n_bins,
             depth=depth_cap,
             n_jobs=n_jobs_padded // hp_size,
+            hist_subtract=dp_size == 1,
         )
     n_total = N + pad_rows(N, dp_size)
     bins_p = _pad_to(bins, n_total, 0)
@@ -273,6 +274,9 @@ def cross_validate_gbdt(
                     axis_name=dp_axis,
                     init_margin=m0,
                     tree_offset=off_l,
+                    # dp>1 keeps the slower direct histograms so scores stay
+                    # bit-identical to a single device (see fit_binned_dp).
+                    hist_subtract=dp_size == 1,
                 )
                 return m1
 

@@ -120,9 +120,11 @@ def test_global_mesh_trains_identically_to_single_device():
         GBDTConfig(n_estimators=8, max_depth=3, n_bins=16, subsample=1.0)
     )
     kw = dict(n_trees_cap=8, depth_cap=3, n_bins=16)
+    # Same algorithm on both sides: dp (>1 devices) builds direct histograms
+    # (models/gbdt.py hist_subtract), so the single-device reference must too.
     ref = fit_binned(
         bins, jnp.asarray(y), jnp.ones(512), jnp.ones(12, bool), hp,
-        jax.random.PRNGKey(0), **kw,
+        jax.random.PRNGKey(0), hist_subtract=False, **kw,
     )
     mesh = make_global_mesh(MeshConfig(hp=1))
     got = fit_binned_dp(

@@ -54,8 +54,12 @@ def test_dp_sharded_fit_matches_single_device(small_binned):
     mesh = make_mesh(MeshConfig(hp=1))
     kw = dict(n_trees_cap=20, depth_cap=3, n_bins=32)
     f_dp = fit_binned_dp(mesh, bins, y, None, None, hp, rng, **kw)
+    # Same algorithm on one device: dp (>1 devices) builds direct histograms
+    # so psum-reduced split decisions stay bit-identical; sibling subtraction
+    # is a single-device-axis fast path (models/gbdt.py hist_subtract).
     f_1 = fit_binned(
-        bins, y, jnp.ones(bins.shape[0]), jnp.ones(bins.shape[1], bool), hp, rng, **kw
+        bins, y, jnp.ones(bins.shape[0]), jnp.ones(bins.shape[1], bool), hp, rng,
+        hist_subtract=False, **kw
     )
     # psum-reduced histograms must reproduce single-device split decisions
     np.testing.assert_array_equal(np.asarray(f_dp.feature), np.asarray(f_1.feature))
@@ -292,6 +296,27 @@ def test_rfecv_scores_and_held_out_auc():
         return roc_auc_score(yte, np.asarray(model.predict_proba(Xte[:, support])[:, 1]))
 
     assert fit_auc(cv.support_) >= fit_auc(plain.support_) - 0.01
+
+
+def test_hist_subtraction_quality_matches_direct(small_binned):
+    """Sibling subtraction (the single-device fast path) may flip near-tie
+    splits vs direct histograms, but the fitted model's quality must be
+    equivalent: same-regime train AUC and near-identical margins."""
+    from cobalt_smart_lender_ai_tpu.ops.metrics import roc_auc
+
+    bins, y, y_np = small_binned
+    hp = GBDTHyperparams.from_config(GBDTConfig(n_estimators=25, max_depth=5))
+    kw = dict(n_trees_cap=25, depth_cap=5, n_bins=32)
+    sw = jnp.ones(bins.shape[0])
+    fm = jnp.ones(bins.shape[1], bool)
+    rng = jax.random.PRNGKey(3)
+    f_sub = fit_binned(bins, y, sw, fm, hp, rng, hist_subtract=True, **kw)
+    f_dir = fit_binned(bins, y, sw, fm, hp, rng, hist_subtract=False, **kw)
+    yf = jnp.asarray(y_np, jnp.float32)
+    auc_sub = float(roc_auc(yf, predict_margin(f_sub, bins, use_binned=True)))
+    auc_dir = float(roc_auc(yf, predict_margin(f_dir, bins, use_binned=True)))
+    assert abs(auc_sub - auc_dir) < 0.005
+    assert auc_sub > 0.9
 
 
 def test_budget_auto_chunk_derivation():
