@@ -64,4 +64,32 @@ def profile_trace(log_dir: str | None) -> Iterator[None]:
         yield
 
 
-__all__ = ["nan_guard", "assert_all_finite", "profile_trace"]
+def enable_persistent_compile_cache(cache_dir: str | None = None) -> str:
+    """Opportunistically enable JAX's persistent compilation cache.
+
+    Remote compiles over this environment's tunneled backend run 40-400s
+    with high variance; the long-running tools (parity, protocol stages,
+    benches) re-compile identical programs every process. A shared on-disk
+    cache turns repeat compiles into ~15-20s deserializations (verified
+    cross-process on the axon backend, round 4). Honors an explicit
+    ``JAX_COMPILATION_CACHE_DIR``; defaults to the user cache dir. Returns
+    the directory in effect."""
+    import os
+
+    cache_dir = (
+        cache_dir
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.path.expanduser("~/.cache/cobalt_smart_lender_ai_tpu/jax_cache")
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    return cache_dir
+
+
+__all__ = [
+    "nan_guard",
+    "assert_all_finite",
+    "profile_trace",
+    "enable_persistent_compile_cache",
+]

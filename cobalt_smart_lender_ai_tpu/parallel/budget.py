@@ -40,21 +40,30 @@ dominates deep trees at small N. Calibration from four measured v5e points:
       stack/subtract step eat part of the halved contraction), hence the
       0.75 effective-width factor rather than 0.5.
 
-A_LEVEL ~ 1e-12, B_NODE ~ 7e-14 (s per row*feat*bin), C_FIX ~ 5.9e-9 (s per
-job*feat*bin*node) reproduce every point within ~10% except the subtract
-path (~10% optimistic). The budget is 24 s — a 2.5x margin under the 60 s
-kill, absorbing the model's error band.
+B_NODE ~ 7e-14 (s per row*feat*bin) and C_FIX ~ 5.9e-9 (s per
+job*feat*bin*node) are pinned by the measured points above to within ~10%.
+A_LEVEL is deliberately NOT a best fit: a steady depth-7 12-job dispatch
+measured 3x the A=1e-12 model (70s — past the kill threshold), so A_LEVEL
+is set to 6e-12 to reproduce that worst case; the model then over-states
+cost up to ~5x at large-N shallow single fits, which only shrinks chunks
+below optimal — the safe direction (see the constant's comment). The
+budget is 24 s — a 2.5x margin under the 60 s kill, absorbing the model's
+remaining error band.
 """
 
 from __future__ import annotations
-
-import math
 
 #: Per-dispatch wall target (seconds). 2.5x under the ~60s dispatch kill.
 DISPATCH_BUDGET_S = 24.0
 
 #: s per row*feat*bin per tree level (bin one-hot build + fixed pass costs).
-A_LEVEL = 1.0e-12
+#: Calibrated HIGH: a steady depth-7 12-job dispatch measured 0.355 s/tree
+#: against this model's 0.121 at A=1e-12 (round-4 probe — the dispatch ran
+#: 70s, uncomfortably past the kill threshold), and 6e-12 reproduces it;
+#: the cost is over-stated ~1.4x at the depth-9 bucket and ~5x at the
+#: large-N shallow single fit, which only makes chunks smaller than optimal
+#: — the safe direction.
+A_LEVEL = 6.0e-12
 #: s per row*feat*bin per tree node (node-one-hot MXU contraction).
 B_NODE = 7.0e-14
 #: s per job*feat*bin per tree node, independent of N (per-block accumulator

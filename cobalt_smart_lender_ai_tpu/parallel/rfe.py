@@ -402,6 +402,7 @@ def rfe_select(
                 n_bins=n_bins,
                 feature_mask=jnp.asarray(fm_np),
                 dp_axis=dp_axis,
+                chunk_trees="auto",  # budget the fold fits like every other
             )
             cv_scores[n] = float(np.asarray(aucs).mean())
             cv_masks[n] = fm_np.copy()

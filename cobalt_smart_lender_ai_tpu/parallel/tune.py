@@ -112,19 +112,26 @@ def stratified_kfold_masks(y: np.ndarray, k: int, seed: int) -> np.ndarray:
     return np.stack([fold_of == f for f in range(k)])
 
 
-def depth_buckets(
+def search_buckets(
     candidates: Sequence[Mapping[str, Any]], base: GBDTConfig
 ) -> list[list[int]]:
-    """Candidate indices bucketed by resolved ``max_depth``, ascending — the
-    dispatch grouping of `randomized_search` (the complete-tree tensors are
-    sized by the structural depth cap, so one depth-9 candidate in a joint
-    batch would force 512-leaf tensors on every vmapped job). Shared with
+    """Candidate indices bucketed by resolved ``(max_depth, n_estimators)``,
+    ascending — the dispatch grouping of `randomized_search`. Depth bounds
+    the structural tree tensors (one depth-9 candidate in a joint batch would
+    force 512-leaf tensors on every vmapped job); n_estimators bounds the
+    boosting rounds actually dispatched (a joint bucket runs every job to the
+    bucket MAX, so five n_est=100 candidates sharing a 300-cap bucket would
+    each burn 200 inert trees of full histogram work — 36% of the reference
+    space's total tree-work). Scores are invariant to any bucketing: AUC is
+    unchanged past a candidate's traced n_estimators/max_depth, and global
+    cand_ids keep RNG streams equal to the joint dispatch's. Shared with
     `tools/protocol_stages.py` so staged runs can never drift from the joint
     dispatch's bucketing."""
-    by_depth: dict[int, list[int]] = {}
+    by_key: dict[tuple[int, int], list[int]] = {}
     for i, cand in enumerate(candidates):
-        by_depth.setdefault(base.replace(**dict(cand)).max_depth, []).append(i)
-    return [by_depth[d] for d in sorted(by_depth)]
+        cfg = base.replace(**dict(cand))
+        by_key.setdefault((cfg.max_depth, cfg.n_estimators), []).append(i)
+    return [by_key[k] for k in sorted(by_key)]
 
 
 @dataclasses.dataclass
@@ -302,6 +309,12 @@ def cross_validate_gbdt(
         ]
     # Every schedule entry has the same chunk size, so exactly one program
     # compiles.
+    logger.info(
+        "cv fan-out: %d jobs x %d trees (depth_cap %d, %d bins, %d rows): "
+        "chunk_trees=%s -> %d dispatch(es)",
+        n_jobs, n_trees_cap, depth_cap, n_bins, N,
+        chunk_trees, len(schedule),
+    )
     runner = make_runner(schedule[0][1])
     margins = jnp.zeros((n_jobs_padded, n_total), jnp.float32)
     # Coarse progress logs (with a blocking sync every ~quarter of the
@@ -309,19 +322,37 @@ def cross_validate_gbdt(
     # backend RPC wedges — the last line printed brackets the hang.
     log_every = max(1, len(schedule) // 4)
     for i, (off, _k_trees) in enumerate(schedule):
-        margins = runner(
-            margins,
-            jnp.int32(off),
-            bins_p,
-            y_p,
-            val_p,
-            w_p,
-            job_hp,
-            job_fold,
-            job_ids,
-            fm,
-            rng,
-        )  # (n_jobs_padded, n_total), sharded (hp, dp)
+        # The FIRST dispatch triggers the (remote) compile, whose RPC
+        # occasionally dies mid-read on this backend — a documented
+        # transient. Its margins input is just zeros, so the retry rebuilds
+        # the (donated, possibly-consumed) buffer and re-issues; later
+        # dispatches carry real margins and a failure there is not safely
+        # retryable (re-raise).
+        for attempt in range(3):
+            try:
+                margins = runner(
+                    margins,
+                    jnp.int32(off),
+                    bins_p,
+                    y_p,
+                    val_p,
+                    w_p,
+                    job_hp,
+                    job_fold,
+                    job_ids,
+                    fm,
+                    rng,
+                )  # (n_jobs_padded, n_total), sharded (hp, dp)
+                break
+            except jax.errors.JaxRuntimeError as e:
+                if i == 0 and attempt < 2 and "remote_compile" in str(e):
+                    logger.warning(
+                        "transient remote-compile failure (attempt %d), "
+                        "retrying: %s", attempt + 1, e,
+                    )
+                    margins = jnp.zeros((n_jobs_padded, n_total), jnp.float32)
+                    continue
+                raise
         if len(schedule) > 1 and (i + 1) % log_every == 0:
             # Scalar fetch, not block_until_ready (which returns immediately
             # over this tunnel): forces execution up to here, bounding the
@@ -369,13 +400,11 @@ def randomized_search(
     )
     fm = None if feature_mask is None else jnp.asarray(feature_mask, bool)
 
-    # Per-bucket dispatches keep each job's tree tensor at its own depth
-    # (see `depth_buckets`). Scores are unchanged by bucketing: AUC is
-    # invariant to the cap (levels beyond a candidate's traced max_depth are
-    # forced trivial), and passing the candidates' *global* indices as
-    # cand_ids keeps every job's RNG stream identical to the joint dispatch's.
+    # Per-bucket dispatches keep each job's tree tensor at its own depth and
+    # its boosting rounds at its own n_estimators (see `search_buckets` for
+    # why scores are invariant to the grouping).
     split_scores = np.zeros((len(candidates), tune.cv_folds))
-    for idxs in depth_buckets(candidates, base):
+    for idxs in search_buckets(candidates, base):
         hps, n_trees_cap, depth_cap = stack_candidates(
             [candidates[i] for i in idxs], base
         )
@@ -416,7 +445,7 @@ __all__ = [
     "sample_candidates",
     "stack_candidates",
     "stratified_kfold_masks",
-    "depth_buckets",
+    "search_buckets",
     "cross_validate_gbdt",
     "randomized_search",
     "SearchResult",

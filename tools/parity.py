@@ -263,9 +263,9 @@ def main(argv=None):
     ap.add_argument("--rows", type=int, default=130_000)
     ap.add_argument("--seed", type=int, default=11)
     # Dispatch budget: "auto" derives per-bucket chunks from the workload
-    # shape (parallel/budget.py) — at 130k rows the depth-9 33-job bucket
-    # lands near 24 rounds/dispatch (50-tree chunks crashed the tunneled TPU
-    # worker once; 12 was the safe hardcode auto replaces). An int pins it.
+    # shape (parallel/budget.py — deliberately conservative after a 70s
+    # dispatch was observed; 50-tree chunks crashed the tunneled TPU worker
+    # once, and 12 was round 3's safe hardcode). An int pins it.
     ap.add_argument(
         "--chunk-trees",
         default="auto",
@@ -274,6 +274,12 @@ def main(argv=None):
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
+    if args.side in ("ours", "both"):
+        from cobalt_smart_lender_ai_tpu.debug import (
+            enable_persistent_compile_cache,
+        )
+
+        enable_persistent_compile_cache()
     if args.side == "merge":
         loaded = [json.load(open(p)) for p in args.inputs]
         by_side = {d.get("side"): d for d in loaded}

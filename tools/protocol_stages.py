@@ -25,7 +25,7 @@ Usage (each stage is one process; rerun any stage that wedges):
     python tools/protocol_stages.py final   --dir /tmp/proto --out BENCH_PROTOCOL.json
 
 The stage count is derived at runtime from the candidate sample through
-`parallel.tune.depth_buckets` (the `stages` subcommand prints it), so it can
+`parallel.tune.search_buckets` (the `stages` subcommand prints it), so it can
 never drift from `randomized_search`'s joint-dispatch bucketing.
 """
 
@@ -50,15 +50,15 @@ CHUNK_TREES = "auto"
 
 
 def _buckets(candidates, base):
-    """Search stages: `parallel.tune.depth_buckets`' EXACT bucketing (shared
+    """Search stages: `parallel.tune.search_buckets`' EXACT bucketing (shared
     helper, so stage indices can never drift from the joint dispatch's), with
     any bucket of >6 candidates split in two so no stage runs >~30 min on
     this backend. Scores stay identical to the joint dispatch either way via
     global cand_ids."""
-    from cobalt_smart_lender_ai_tpu.parallel.tune import depth_buckets
+    from cobalt_smart_lender_ai_tpu.parallel.tune import search_buckets
 
     stages = []
-    for idxs in depth_buckets(candidates, base):
+    for idxs in search_buckets(candidates, base):
         if len(idxs) > 6:
             stages.append(idxs[: len(idxs) // 2])
             stages.append(idxs[len(idxs) // 2:])
@@ -321,6 +321,9 @@ def main(argv=None):
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s [%(levelname)s] %(message)s"
     )
+    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()  # stages re-run identical programs
     if args.stage == "prep":
         stage_prep(args)
     elif args.stage == "stages":
