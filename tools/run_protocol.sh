@@ -1,0 +1,56 @@
+#!/bin/bash
+# Drive the full staged protocol bench end to end with per-stage retries.
+# Each stage is its own process (tools/protocol_stages.py); a stage that
+# wedges on a hung backend RPC is simply re-run — intermediates persist in
+# $DIR and the per-stage walls recorded in $DIR/*.json are the timings the
+# final BENCH_PROTOCOL.json sums.
+#
+# Usage: bash tools/run_protocol.sh [rows] [dir] [out]
+set -u
+ROWS="${1:-2300000}"
+DIR="${2:-/tmp/proto_r4}"
+OUT="${3:-BENCH_PROTOCOL.json}"
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD:${PYTHONPATH:-/root/.axon_site}"
+
+log() { echo "[run_protocol $(date +%H:%M:%S)] $*"; }
+
+if [ ! -f "$DIR/prep.json" ]; then
+  for attempt in 1 2; do
+    log "prep attempt $attempt (rows=$ROWS)"
+    timeout 10800 python tools/protocol_stages.py prep --rows "$ROWS" --dir "$DIR" && break
+  done
+  [ -f "$DIR/prep.json" ] || { log "prep failed twice"; exit 1; }
+fi
+
+N=$(python - <<'EOF'
+import io, json, contextlib, sys
+sys.argv = ["protocol_stages", "stages"]
+buf = io.StringIO()
+sys.path.insert(0, "tools")
+import protocol_stages
+with contextlib.redirect_stdout(buf):
+    protocol_stages.main(["stages"])
+print(json.loads(buf.getvalue())["n_stages"])
+EOF
+)
+log "search stages: $N"
+
+i=0
+while [ "$i" -lt "$N" ]; do
+  if [ ! -f "$DIR/search$i.json" ]; then
+    for attempt in 1 2 3; do
+      log "search$i attempt $attempt"
+      timeout 7200 python tools/protocol_stages.py "search$i" --dir "$DIR" && break
+    done
+    [ -f "$DIR/search$i.json" ] || { log "search$i failed 3x"; exit 1; }
+  fi
+  i=$((i+1))
+done
+
+for attempt in 1 2; do
+  log "final attempt $attempt"
+  timeout 7200 python tools/protocol_stages.py final --dir "$DIR" --out "$OUT" && break
+done
+[ -f "$OUT" ] || { log "final failed twice"; exit 1; }
+log "done: $OUT"
