@@ -96,6 +96,7 @@ def run_protocol(n_rows: int, seed: int = 5) -> dict:
     total = time.time() - t1
     return {
         "metric": "full_protocol_rows_per_sec_per_chip",
+        "produced_by": "bench.py --protocol (single process)",
         "value": round(n_rows / total, 1),
         "unit": (
             f"rows/s ({n_rows/1e6:.1f}M-row raw frame through the whole "
@@ -220,8 +221,9 @@ def main() -> None:
         with open(proto_path) as f:
             proto = json.load(f)
         line["protocol"] = {
-            "source": "BENCH_PROTOCOL.json (bench.py --protocol; measured on "
-            + proto.get("device", "?") + ")",
+            "source": "BENCH_PROTOCOL.json ("
+            + proto.get("produced_by", "full-protocol measurement")
+            + "; measured on " + proto.get("device", "?") + ")",
             "rows_per_sec_per_chip": proto.get("value"),
             "seconds_total": proto.get("seconds_total"),
             "seconds_stage": proto.get("seconds_stage"),

@@ -72,8 +72,11 @@ def enable_persistent_compile_cache(cache_dir: str | None = None) -> str:
     benches) re-compile identical programs every process. A shared on-disk
     cache turns repeat compiles into ~15-20s deserializations (verified
     cross-process on the axon backend, round 4). Honors an explicit
-    ``JAX_COMPILATION_CACHE_DIR``; defaults to the user cache dir. Returns
-    the directory in effect."""
+    ``JAX_COMPILATION_CACHE_DIR``; defaults to the user cache dir.
+    Opportunistic for real: an unwritable cache directory (read-only HOME
+    in a hardened container) degrades to no caching instead of failing the
+    caller. Returns the directory in effect, or None when disabled."""
+    import logging
     import os
 
     cache_dir = (
@@ -81,9 +84,16 @@ def enable_persistent_compile_cache(cache_dir: str | None = None) -> str:
         or os.environ.get("JAX_COMPILATION_CACHE_DIR")
         or os.path.expanduser("~/.cache/cobalt_smart_lender_ai_tpu/jax_cache")
     )
-    os.makedirs(cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except OSError as e:
+        logging.getLogger(__name__).warning(
+            "persistent compile cache disabled (%s unwritable: %s)",
+            cache_dir, e,
+        )
+        return None
     return cache_dir
 
 

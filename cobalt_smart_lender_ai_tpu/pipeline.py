@@ -259,6 +259,9 @@ def main(argv=None) -> PipelineResult:
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s [%(levelname)s] %(message)s"
     )
+    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
     cfg = PipelineConfig()
     if args.quick:
         cfg = dataclasses.replace(

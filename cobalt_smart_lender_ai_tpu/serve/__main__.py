@@ -29,6 +29,11 @@ def main() -> None:
     parser.add_argument("--port", type=int, default=ServeConfig.port)
     args = parser.parse_args()
 
+    # Scorer-bucket compiles persist across service restarts (tens of
+    # seconds each on a cold backend; the cache makes a restart warm).
+    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
     cfg = ServeConfig(host=args.host, port=args.port, model_key=args.model_key)
     service = ScorerService.from_store(ObjectStore(args.store), cfg)
     print(f"[INFO] model restored from {args.store}/{cfg.model_key}; "

@@ -46,19 +46,22 @@ def bench_family(make_model, fit_args, test_args, y_test, short=2, long=12):
     _ready(m, test_args)
     t_cold_full = time.time() - t0
 
-    t0 = time.time()
-    m = make_model(short)
-    m.fit(*fit_args)
-    _ready(m, test_args)
-    t_short = time.time() - t0
-    e_short = len(m.history["loss"])
+    # Each timed fit runs twice and the MIN wall is kept: the tunneled
+    # backend's per-RPC latency is additive noise measured in seconds
+    # (single-run steady numbers swung 300x between invocations), and min
+    # over repeats filters it the way microbenchmark best-of-N does.
+    def timed_fit(epochs):
+        walls = []
+        for _ in range(2):
+            t0 = time.time()
+            m = make_model(epochs)
+            m.fit(*fit_args)
+            _ready(m, test_args)
+            walls.append(time.time() - t0)
+        return min(walls), len(m.history["loss"]), m
 
-    t0 = time.time()
-    m = make_model(long)
-    m.fit(*fit_args)
-    _ready(m, test_args)
-    t_long = time.time() - t0
-    e_long = len(m.history["loss"])  # early stopping may trim this
+    t_short, e_short, _ = timed_fit(short)
+    t_long, e_long, m = timed_fit(long)  # early stopping may trim e_long
 
     # Both timed fits run fully warm, so the epoch delta divides cleanly;
     # divide by the epochs actually run, not the configured count. The
@@ -158,13 +161,17 @@ def main(argv=None):
         "features": len(names),
     }
 
+    # Short/long spreads: with K epochs amortized per dispatch
+    # (epochs_per_dispatch), both fits must span MULTIPLE dispatches or the
+    # delta collapses into dispatch-count noise and only a lower bound comes
+    # out (throughput_measurement flags it).
     results["mlp"] = bench_family(
         lambda e: MLPClassifier(MLPConfig(epochs=e, early_stop_patience=10_000)),
         (Xtr_n, ytr_n),
         (Xte_n,),
         yte_n,
-        short=2,
-        long=22,
+        short=16,
+        long=48,
     )
     print("mlp:", json.dumps(results["mlp"]))
 
@@ -188,8 +195,8 @@ def main(argv=None):
         (Xtr_n, ytr_n),
         (Xte_n,),
         yte_n,
-        short=1,
-        long=8,
+        short=16,
+        long=48,
     )
     print("tabnet:", json.dumps(results["tabnet"]))
 

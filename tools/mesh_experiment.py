@@ -1,0 +1,151 @@
+"""hp-axis fan-out scheduling evidence on the 8-virtual-device CPU mesh.
+
+The real pod claim — CV x HPO jobs sharded over the ``hp`` mesh axis run
+concurrently on separate chips — cannot be *timed* in this environment
+(one physical TPU chip; the 8-device CPU mesh is 8 XLA devices backed by ONE
+host core, so wall-clock cannot improve). What CAN be evidenced here:
+
+1. Work division: with ``hp=8``, each device's shard_map block receives
+   jobs/8 vmapped jobs (vs all jobs at ``hp=1``). This follows from the
+   fan-out's partition specs (`parallel/tune.py` shards the job axis
+   ``P(hp_axis)`` over the mesh); the per-shape ``jobs_per_device_block``
+   recorded below is computed from that partition arithmetic, not
+   re-measured — the *behavioral* evidence is item 2.
+2. Score invariance: the same candidate grid scores identically on
+   (hp=1, dp=8), (hp=2, dp=4), (hp=8, dp=1) meshes — the global cand_id RNG
+   design (also gated by tests/test_parallel.py on every CI run).
+3. Honest wall-clocks for the three shapes on the shared single core, as a
+   sanity record (expected ~flat; any large regression would indicate a
+   scheduling pathology, e.g. serialization overhead growing with hp).
+
+Produces MESH_EXPERIMENT.json. Run with:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/mesh_experiment.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=40_000)
+    ap.add_argument("--out", default="MESH_EXPERIMENT.json")
+    args = ap.parse_args(argv)
+
+    import os
+    import re
+
+    import jax
+
+    # A sitecustomize may have pinned the tunneled axon backend; force the
+    # 8-virtual-device CPU backend before the first backend touch (same
+    # dance as __graft_entry__.dryrun_multichip).
+    flag = "--xla_force_host_platform_device_count=8"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+    else:
+        os.environ["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags
+        )
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from cobalt_smart_lender_ai_tpu.config import GBDTConfig, MeshConfig
+    from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTHyperparams
+    from cobalt_smart_lender_ai_tpu.ops.binning import compute_bin_edges, transform
+    from cobalt_smart_lender_ai_tpu.parallel.mesh import make_mesh
+    from cobalt_smart_lender_ai_tpu.parallel.tune import (
+        cross_validate_gbdt,
+        stratified_kfold_masks,
+    )
+
+    assert len(jax.devices()) >= 8, "run on the 8-virtual-device CPU backend"
+
+    rng = np.random.default_rng(0)
+    n, f = args.rows, 20
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] + rng.logistic(size=n) * 0.7 > 0).astype(
+        np.int32
+    )
+    Xd = jnp.asarray(X)
+    spec = compute_bin_edges(Xd, n_bins=64)
+    bins = transform(spec, Xd)
+    yd = jnp.asarray(y)
+    val_masks = jnp.asarray(stratified_kfold_masks(y, 2, seed=0))
+
+    cands = [
+        GBDTConfig(n_estimators=30, max_depth=4, n_bins=64, learning_rate=lr)
+        for lr in (0.05, 0.1, 0.2, 0.3)
+    ]
+    hps = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[GBDTHyperparams.from_config(c) for c in cands],
+    )
+
+    results = {"rows": n, "jobs": len(cands) * 2, "shapes": []}
+    scores = {}
+    for hp_size in (1, 2, 8):
+        mesh = make_mesh(MeshConfig(hp=hp_size))
+        t0 = time.time()
+        aucs = cross_validate_gbdt(
+            mesh,
+            bins,
+            yd,
+            hps,
+            val_masks,
+            jax.random.PRNGKey(0),
+            n_trees_cap=30,
+            depth_cap=4,
+            n_bins=64,
+        )
+        aucs = np.asarray(aucs)
+        wall = round(time.time() - t0, 2)
+        n_jobs = aucs.size
+        jobs_local = -(-n_jobs // hp_size)
+        results["shapes"].append(
+            {
+                "mesh": {"hp": hp_size, "dp": 8 // hp_size},
+                "wall_seconds_single_core_host": wall,
+                "jobs_per_device_block": jobs_local,
+            }
+        )
+        scores[hp_size] = aucs
+    base = scores[1]
+    for k, v in scores.items():
+        np.testing.assert_allclose(
+            v, base, atol=1e-5,
+            err_msg=f"hp={k} scores diverge from hp=1",
+        )
+    results["scores_identical_across_shapes"] = True
+    results["mean_auc"] = round(float(base.mean()), 4)
+    results["note"] = (
+        "8 virtual XLA devices share ONE physical core, so wall-clock "
+        "cannot improve with hp here; jobs_per_device_block is derived "
+        "from the fan-out's P(hp) partition spec (not re-measured), and "
+        "the behavioral evidence is the measured score invariance across "
+        "mesh shapes — the correctness half of the pod-scaling claim. "
+        "tests/test_parallel.py gates the same invariance on every run."
+    )
+    print(json.dumps(results, indent=2))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=2)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -258,8 +258,9 @@ def stage_final(args):
             f"test AUC {test_auc:.4f}, cv AUC {mean_auc[best_i]:.4f}; "
             "vs_baseline = x over the 4,791 rows/s/chip v4-8 <60s budget; "
             "staged run: per-stage processes with persisted intermediates, "
-            "re-upload overhead included in each stage's wall"
+            "re-upload overhead included in each stage's wall)"
         ),
+        "produced_by": "tools/protocol_stages.py (restartable staged runner)",
         "vs_baseline": round(n_rows / total / NORTH_STAR_ROWS_PER_SEC_PER_CHIP, 3),
         "seconds_total": total,
         "seconds_stage": timings,
