@@ -64,7 +64,7 @@ def profile_trace(log_dir: str | None) -> Iterator[None]:
         yield
 
 
-def enable_persistent_compile_cache(cache_dir: str | None = None) -> str:
+def enable_persistent_compile_cache(cache_dir: str | None = None) -> str | None:
     """Opportunistically enable JAX's persistent compilation cache.
 
     Remote compiles over this environment's tunneled backend run 40-400s
@@ -97,9 +97,33 @@ def enable_persistent_compile_cache(cache_dir: str | None = None) -> str:
     return cache_dir
 
 
+def force_virtual_cpu_devices(n: int) -> None:
+    """Force the ``n``-virtual-device CPU backend before the first backend
+    touch — the standard JAX fake-backend trick for exercising multi-chip
+    code paths on one host (SURVEY §4c), robust to a sitecustomize that
+    pinned a tunneled accelerator. Must run before anything calls
+    ``jax.devices()`` in the process. (tests/conftest.py keeps its own copy
+    because it must run before this package is importable from the test
+    environment's point of view.)"""
+    import os
+    import re
+
+    flag = f"--xla_force_host_platform_device_count={n}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags
+        )
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+    jax.config.update("jax_platforms", "cpu")
+
+
 __all__ = [
     "nan_guard",
     "assert_all_finite",
     "profile_trace",
     "enable_persistent_compile_cache",
+    "force_virtual_cpu_devices",
 ]

@@ -53,8 +53,12 @@ remaining error band.
 
 from __future__ import annotations
 
-#: Per-dispatch wall target (seconds). 2.5x under the ~60s dispatch kill.
-DISPATCH_BUDGET_S = 24.0
+#: Per-dispatch wall target (seconds). Originally 24 (2.5x under the ~60s
+#: dispatch kill); tightened after the 2.3M-row protocol run, where the
+#: depth-5 search stage's dispatches ran ~2x the model estimate (47s
+#: observed — only 1.3x from the kill). 18 keeps even a 2x model miss
+#: near 36s.
+DISPATCH_BUDGET_S = 18.0
 
 #: s per row*feat*bin per tree level (bin one-hot build + fixed pass costs).
 #: Calibrated HIGH: a steady depth-7 12-job dispatch measured 0.355 s/tree

@@ -71,16 +71,23 @@ def bench_family(make_model, fit_args, test_args, y_test, short=2, long=12):
     # (a degraded measurement). Either way the reported number includes
     # per-fit fixed overheads — a LOWER BOUND on steady state, flagged as
     # such rather than silently reported as steady.
+    # Delta noise floor: per-fit walls on the tunneled backend carry
+    # seconds of RPC jitter even after best-of-2, so an epoch delta under
+    # this is not a measurement — a 1.4s delta once yielded a "steady"
+    # 4.8M rows/s for the MLP. Below the floor, report the whole-fit
+    # lower bound instead (overheads included, flagged).
+    NOISE_FLOOR_S = 5.0
     measurement = "steady"
-    if e_long > e_short and t_long > t_short:
+    if e_long > e_short and t_long - t_short >= NOISE_FLOOR_S:
         steady = rows * (e_long - e_short) / (t_long - t_short)
     else:
         steady = rows * e_long / max(t_long, 1e-9)
-        measurement = (
-            "lower_bound_early_stop_clamped"
-            if e_long <= e_short
-            else "lower_bound_timing_noise"
-        )
+        if e_long <= e_short:
+            measurement = "lower_bound_early_stop_clamped"
+        elif t_long <= t_short:
+            measurement = "lower_bound_timing_noise"
+        else:
+            measurement = "lower_bound_delta_below_noise_floor"
     p = np.asarray(m.predict_proba(*test_args)[:, 1])
     auc = float(roc_auc_score(np.asarray(y_test), p))
     return {
@@ -185,8 +192,8 @@ def main(argv=None):
             ft_fit,
             ft_test,
             yte_n,
-            short=1,
-            long=5,
+            short=4,
+            long=10,
         )
         print("ft_transformer:", json.dumps(results["ft_transformer"]))
 

@@ -44,23 +44,13 @@ def main(argv=None):
     ap.add_argument("--out", default="MESH_EXPERIMENT.json")
     args = ap.parse_args(argv)
 
-    import os
-    import re
-
     import jax
 
+    from cobalt_smart_lender_ai_tpu.debug import force_virtual_cpu_devices
+
     # A sitecustomize may have pinned the tunneled axon backend; force the
-    # 8-virtual-device CPU backend before the first backend touch (same
-    # dance as __graft_entry__.dryrun_multichip).
-    flag = "--xla_force_host_platform_device_count=8"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
-    else:
-        os.environ["XLA_FLAGS"] = re.sub(
-            r"--xla_force_host_platform_device_count=\d+", flag, flags
-        )
-    jax.config.update("jax_platforms", "cpu")
+    # 8-virtual-device CPU backend before the first backend touch.
+    force_virtual_cpu_devices(8)
 
     import jax.numpy as jnp
 
