@@ -56,3 +56,48 @@ def test_profile_trace_writes_events(tmp_path):
 def test_profile_trace_noop_when_disabled():
     with profile_trace(None):
         pass
+
+
+def test_persistent_compile_cache_sets_config(tmp_path):
+    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+
+    prev = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        d = enable_persistent_compile_cache(str(tmp_path / "cache"))
+        assert d == str(tmp_path / "cache")
+        assert jax.config.jax_compilation_cache_dir == d
+        assert (tmp_path / "cache").is_dir()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
+
+
+def test_persistent_compile_cache_degrades_on_unwritable_dir(tmp_path):
+    """Opportunistic for real: an unwritable cache path must disable caching
+    (return None), never raise into the caller (the serve entrypoint calls
+    this unconditionally)."""
+    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+
+    blocker = tmp_path / "file"
+    blocker.write_text("not a dir")
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        # makedirs under a regular file raises OSError -> swallowed.
+        assert enable_persistent_compile_cache(str(blocker / "cache")) is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_force_virtual_cpu_devices_is_idempotent_on_cpu():
+    """Under the test harness the backend is already the 8-device virtual
+    CPU; re-forcing the same count must keep the flag singular and the
+    platform cpu (the helper regex-replaces rather than appends)."""
+    import os
+
+    from cobalt_smart_lender_ai_tpu.debug import force_virtual_cpu_devices
+
+    force_virtual_cpu_devices(8)
+    flags = os.environ.get("XLA_FLAGS", "")
+    assert flags.count("xla_force_host_platform_device_count") == 1
+    assert len(jax.devices()) == 8
