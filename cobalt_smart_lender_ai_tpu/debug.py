@@ -97,6 +97,41 @@ def enable_persistent_compile_cache(cache_dir: str | None = None) -> str | None:
     return cache_dir
 
 
+def is_transient_compile_error(e: Exception) -> bool:
+    """True for the tunneled backend's known-transient remote-compile RPC
+    failure ("response body closed before all bytes were read"). Only the
+    FIRST dispatch of a program can hit it (later dispatches reuse the
+    compiled executable), and first dispatches in this codebase start from
+    rebuildable state (zero margins / initial masks), so callers retry
+    exactly there — see `retry_first_dispatch`."""
+    return isinstance(e, jax.errors.JaxRuntimeError) and "remote_compile" in str(e)
+
+
+def retry_first_dispatch(dispatch, rebuild, *, is_first: bool, attempts: int = 3):
+    """Run ``dispatch()`` and retry the transient remote-compile RPC failure.
+
+    Valid ONLY when ``is_first`` — a program's first dispatch, whose
+    (possibly donated/consumed) input state ``rebuild()`` recreates before
+    the retry; later dispatches carry real state and re-raise. One retry
+    policy for every chunked loop (`fit_binned_chunked`,
+    `fit_binned_dp_chunked`, the device-stepped RFE, `cross_validate_gbdt`).
+    """
+    import logging
+
+    for attempt in range(attempts):
+        try:
+            return dispatch()
+        except Exception as e:
+            if is_first and attempt < attempts - 1 and is_transient_compile_error(e):
+                logging.getLogger(__name__).warning(
+                    "transient remote-compile failure (attempt %d), "
+                    "retrying: %s", attempt + 1, e,
+                )
+                rebuild()
+                continue
+            raise
+
+
 def force_virtual_cpu_devices(n: int) -> None:
     """Force the ``n``-virtual-device CPU backend before the first backend
     touch — the standard JAX fake-backend trick for exercising multi-chip
@@ -125,5 +160,7 @@ __all__ = [
     "assert_all_finite",
     "profile_trace",
     "enable_persistent_compile_cache",
+    "is_transient_compile_error",
+    "retry_first_dispatch",
     "force_virtual_cpu_devices",
 ]

@@ -471,22 +471,33 @@ def fit_binned_chunked(
             depth_cap=depth_cap,
             n_bins=n_bins,
         )
+    from cobalt_smart_lender_ai_tpu.debug import retry_first_dispatch
+
     N = bins.shape[0]
     margin = jnp.zeros((N,), jnp.float32)
     chunks = []
     for off in range(0, n_trees_cap, chunk_trees):
-        forest_c, margin = fit_binned_resumable(
-            bins,
-            y,
-            sample_weight,
-            feature_mask,
-            hp,
-            rng,
-            n_trees_cap=chunk_trees,
-            depth_cap=depth_cap,
-            n_bins=n_bins,
-            init_margin=margin,
-            tree_offset=jnp.int32(off),
+        def _dispatch():
+            return fit_binned_resumable(
+                bins,
+                y,
+                sample_weight,
+                feature_mask,
+                hp,
+                rng,
+                n_trees_cap=chunk_trees,
+                depth_cap=depth_cap,
+                n_bins=n_bins,
+                init_margin=margin,
+                tree_offset=jnp.int32(off),
+            )
+
+        def _rebuild():
+            nonlocal margin
+            margin = jnp.zeros((N,), jnp.float32)
+
+        forest_c, margin = retry_first_dispatch(
+            _dispatch, _rebuild, is_first=off == 0
         )
         chunks.append(forest_c)
     return concat_forest_chunks(chunks, n_trees_cap, depth_cap)

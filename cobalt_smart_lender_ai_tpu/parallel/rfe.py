@@ -201,19 +201,30 @@ def _eliminate_on_device(
 
         args = ()
 
-    mask = jnp.ones((F,), bool)
-    ranking = jnp.ones((F,), jnp.int32)
-    next_rank = jnp.int32(n_iters + 1)
+    def _initial_carry():
+        return (
+            jnp.ones((F,), bool),
+            jnp.ones((F,), jnp.int32),
+            jnp.int32(n_iters + 1),
+        )
+
+    from cobalt_smart_lender_ai_tpu.debug import retry_first_dispatch
+
+    mask, ranking, next_rank = _initial_carry()
     history = []
     for it0 in range(0, n_iters, steps_per_dispatch):
-        if multi:
-            mask, ranking, next_rank, hist = runner(
-                *args, mask, ranking, next_rank, jnp.int32(it0), hp, rng
-            )
-        else:
-            mask, ranking, next_rank, hist = runner(
-                mask, ranking, next_rank, jnp.int32(it0), hp, rng
-            )
+        def _dispatch():
+            return runner(*args, mask, ranking, next_rank, jnp.int32(it0), hp, rng)
+
+        def _rebuild():
+            # The first dispatch compiles the K-step program and starts from
+            # the initial carry — safely rebuilt for the retry.
+            nonlocal mask, ranking, next_rank
+            mask, ranking, next_rank = _initial_carry()
+
+        mask, ranking, next_rank, hist = retry_first_dispatch(
+            _dispatch, _rebuild, is_first=it0 == 0
+        )
         if want_history:
             history.append(np.asarray(hist[: n_iters - it0]))
     mask_np = np.asarray(mask)

@@ -168,11 +168,23 @@ def fit_binned_dp_chunked(
             hist_subtract=mesh.shape[dp_axis] == 1,  # see fit_binned_dp
         )
 
+    from cobalt_smart_lender_ai_tpu.debug import retry_first_dispatch
+
     runner = jax.jit(_chunk, donate_argnums=(0,))
     margin = jnp.zeros((n_total,), jnp.float32)
     chunks = []
     for off in range(0, n_trees_cap, chunk_trees):
-        forest_c, margin = runner(margin, jnp.int32(off), bins, y, sw, fm, hp, rng)
+        def _dispatch():
+            return runner(margin, jnp.int32(off), bins, y, sw, fm, hp, rng)
+
+        def _rebuild():
+            # The donated margin input is just zeros on the first dispatch.
+            nonlocal margin
+            margin = jnp.zeros((n_total,), jnp.float32)
+
+        forest_c, margin = retry_first_dispatch(
+            _dispatch, _rebuild, is_first=off == 0
+        )
         chunks.append(forest_c)
     return concat_forest_chunks(chunks, n_trees_cap, depth_cap)
 

@@ -327,32 +327,29 @@ def cross_validate_gbdt(
         # transient. Its margins input is just zeros, so the retry rebuilds
         # the (donated, possibly-consumed) buffer and re-issues; later
         # dispatches carry real margins and a failure there is not safely
-        # retryable (re-raise).
-        for attempt in range(3):
-            try:
-                margins = runner(
-                    margins,
-                    jnp.int32(off),
-                    bins_p,
-                    y_p,
-                    val_p,
-                    w_p,
-                    job_hp,
-                    job_fold,
-                    job_ids,
-                    fm,
-                    rng,
-                )  # (n_jobs_padded, n_total), sharded (hp, dp)
-                break
-            except jax.errors.JaxRuntimeError as e:
-                if i == 0 and attempt < 2 and "remote_compile" in str(e):
-                    logger.warning(
-                        "transient remote-compile failure (attempt %d), "
-                        "retrying: %s", attempt + 1, e,
-                    )
-                    margins = jnp.zeros((n_jobs_padded, n_total), jnp.float32)
-                    continue
-                raise
+        # retryable (re-raise). Shared policy: debug.retry_first_dispatch.
+        from cobalt_smart_lender_ai_tpu.debug import retry_first_dispatch
+
+        def _dispatch():
+            return runner(
+                margins,
+                jnp.int32(off),
+                bins_p,
+                y_p,
+                val_p,
+                w_p,
+                job_hp,
+                job_fold,
+                job_ids,
+                fm,
+                rng,
+            )  # (n_jobs_padded, n_total), sharded (hp, dp)
+
+        def _rebuild():
+            nonlocal margins
+            margins = jnp.zeros((n_jobs_padded, n_total), jnp.float32)
+
+        margins = retry_first_dispatch(_dispatch, _rebuild, is_first=i == 0)
         if len(schedule) > 1 and (i + 1) % log_every == 0:
             # Scalar fetch, not block_until_ready (which returns immediately
             # over this tunnel): forces execution up to here, bounding the

@@ -89,6 +89,40 @@ def test_persistent_compile_cache_degrades_on_unwritable_dir(tmp_path):
         jax.config.update("jax_compilation_cache_dir", prev)
 
 
+def test_retry_first_dispatch_policy():
+    """Retries the transient remote-compile failure on the first dispatch
+    only (rebuilding state), re-raises everything else."""
+    from cobalt_smart_lender_ai_tpu.debug import retry_first_dispatch
+
+    calls = {"n": 0, "rebuilt": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise jax.errors.JaxRuntimeError(
+                "INTERNAL: http://x/remote_compile: read body closed"
+            )
+        return "ok"
+
+    out = retry_first_dispatch(
+        flaky, lambda: calls.__setitem__("rebuilt", calls["rebuilt"] + 1),
+        is_first=True,
+    )
+    assert out == "ok" and calls == {"n": 2, "rebuilt": 1}
+
+    def always():
+        raise jax.errors.JaxRuntimeError("remote_compile: read body closed")
+
+    with pytest.raises(jax.errors.JaxRuntimeError):  # not first -> no retry
+        retry_first_dispatch(always, lambda: None, is_first=False)
+    with pytest.raises(ValueError):  # non-transient -> no retry
+        retry_first_dispatch(
+            lambda: (_ for _ in ()).throw(ValueError("boom")),
+            lambda: None,
+            is_first=True,
+        )
+
+
 def test_force_virtual_cpu_devices_is_idempotent_on_cpu():
     """Under the test harness the backend is already the 8-device virtual
     CPU; re-forcing the same count must keep the flag singular and the
