@@ -220,6 +220,14 @@ def main() -> None:
     if os.path.exists(proto_path):
         with open(proto_path) as f:
             proto = json.load(f)
+        # Quality and throughput must travel together on the HEADLINE line:
+        # the 0.9x AUC above is a quick planted-logit signal, while the tuned
+        # full-protocol AUC (>= the reference's 0.9530) is the parity claim.
+        line["tuned_test_auc"] = proto.get("test_auc")
+        line["unit"] += (
+            f"; tuned full-protocol test AUC {proto.get('test_auc')} "
+            "(see protocol)"
+        )
         line["protocol"] = {
             "source": "BENCH_PROTOCOL.json ("
             + proto.get("produced_by", "full-protocol measurement")

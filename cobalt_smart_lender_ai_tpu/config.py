@@ -59,6 +59,14 @@ class GBDTConfig:
     #: against the dispatch budget (`parallel/budget.py`). None = single
     #: dispatch.
     chunk_trees: int | str | None = None
+    #: Sibling-subtraction histograms (left child built, right = parent -
+    #: left) — the single-device fast path. NOTE a reproducibility caveat:
+    #: dp>1 row-sharded fits always run direct histograms (subtraction
+    #: amplifies psum reduction-order float differences into near-tie split
+    #: flips), so a default single-device fit is NOT bit-identical to a dp>1
+    #: fit of the same config+seed. Set False when cross-mesh bit-identity
+    #: matters more than the ~25% single-device speedup.
+    hist_subtract: bool = True
 
     def replace(self, **kw: Any) -> "GBDTConfig":
         return dataclasses.replace(self, **kw)
@@ -155,6 +163,9 @@ class RFEConfig:
     max_depth: int = 6
     scale_pos_weight: float = 1.0  # reference passes it to the RFE estimator
     seed: int = 42
+    #: Sibling-subtraction histograms for the selector fits — same
+    #: cross-mesh reproducibility caveat as GBDTConfig.hist_subtract.
+    hist_subtract: bool = True
     #: Whole elimination steps (fit -> gains -> drop) advanced per XLA
     #: dispatch, with the surviving-feature mask carried ON DEVICE
     #: (`parallel/rfe.py _advance_elimination`) — bit-identical to stepping on

@@ -103,8 +103,34 @@ def is_transient_compile_error(e: Exception) -> bool:
     FIRST dispatch of a program can hit it (later dispatches reuse the
     compiled executable), and first dispatches in this codebase start from
     rebuildable state (zero margins / initial masks), so callers retry
-    exactly there — see `retry_first_dispatch`."""
-    return isinstance(e, jax.errors.JaxRuntimeError) and "remote_compile" in str(e)
+    exactly there — see `retry_first_dispatch`.
+
+    The match requires BOTH the remote_compile marker and an RPC
+    channel-failure symptom: a deterministic compiler error whose message
+    merely mentions remote_compile must fail fast, not retry 3x."""
+    if not isinstance(e, jax.errors.JaxRuntimeError):
+        return False
+    msg = str(e)
+    if "remote_compile" not in msg:
+        return False
+    transient_symptoms = (
+        "response body closed",  # the documented mid-read RPC death
+        "bytes were read",
+        "connection reset",
+        "broken pipe",
+        "socket",
+        "stream reset",
+        "EOF",
+        "502", "503", "504",  # proxy/tunnel gateway deaths
+        "UNAVAILABLE",
+        "DEADLINE_EXCEEDED",
+        # The documented RPC death surfaces as INTERNAL; deterministic
+        # compiler failures carry INVALID_ARGUMENT/NOT_FOUND/UNIMPLEMENTED
+        # statuses and verifier text, so INTERNAL-status remote_compile
+        # failures are treated as channel deaths.
+        "INTERNAL",
+    )
+    return any(s.lower() in msg.lower() for s in transient_symptoms)
 
 
 def retry_first_dispatch(dispatch, rebuild, *, is_first: bool, attempts: int = 3):

@@ -445,6 +445,7 @@ def fit_binned_chunked(
     depth_cap: int,
     n_bins: int,
     chunk_trees: int,
+    hist_subtract: bool = True,
 ) -> Forest:
     """Host-loop fit in chunks of ``chunk_trees`` boosting rounds per XLA
     dispatch, carrying the margin between dispatches. Numerically identical
@@ -470,6 +471,7 @@ def fit_binned_chunked(
             n_trees_cap=n_trees_cap,
             depth_cap=depth_cap,
             n_bins=n_bins,
+            hist_subtract=hist_subtract,
         )
     from cobalt_smart_lender_ai_tpu.debug import retry_first_dispatch
 
@@ -490,6 +492,7 @@ def fit_binned_chunked(
                 n_bins=n_bins,
                 init_margin=margin,
                 tree_offset=jnp.int32(off),
+                hist_subtract=hist_subtract,
             )
 
         def _rebuild():
@@ -642,14 +645,17 @@ class GBDTClassifier:
                 n_feats=F,
                 n_bins=cfg.n_bins,
                 depth=cfg.max_depth,
-                hist_subtract=True,  # single-device fit path
+                hist_subtract=cfg.hist_subtract,
             )
         if chunk is not None:
             forest = fit_binned_chunked(
-                bins, y, sw, fm, hp, key, chunk_trees=chunk, **kw
+                bins, y, sw, fm, hp, key, chunk_trees=chunk,
+                hist_subtract=cfg.hist_subtract, **kw,
             )
         else:
-            forest = fit_binned(bins, y, sw, fm, hp, key, **kw)
+            forest = fit_binned(
+                bins, y, sw, fm, hp, key, hist_subtract=cfg.hist_subtract, **kw
+            )
         self.forest = attach_float_thresholds(forest, self.bin_spec)
         return self
 

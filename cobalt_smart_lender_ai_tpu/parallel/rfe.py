@@ -164,6 +164,7 @@ def _eliminate_on_device(
         n_trees_cap=cfg.n_estimators,
         depth_cap=cfg.max_depth,
         n_bins=n_bins,
+        hist_subtract=cfg.hist_subtract,
     )
     multi = mesh is not None and mesh.devices.size > 1
     if multi:
@@ -184,11 +185,14 @@ def _eliminate_on_device(
             return _advance_elimination(
                 bins_l, y_l, sw_l, mask, ranking, next_rank, it0, hp_l, rng_l,
                 axis_name=dp_axis,
-                # dp>1: direct histograms keep the device-stepped loop
-                # bit-identical to the host loop's dp fits (see
-                # sharded.fit_binned_dp).
-                hist_subtract=mesh.shape[dp_axis] == 1,
-                **kw,
+                **{
+                    **kw,
+                    # dp>1: direct histograms keep the device-stepped loop
+                    # bit-identical to the host loop's dp fits (see
+                    # sharded.fit_binned_dp).
+                    "hist_subtract": cfg.hist_subtract
+                    and mesh.shape[dp_axis] == 1,
+                },
             )
 
         runner = jax.jit(_run)
@@ -278,7 +282,8 @@ def rfe_select(
     n_local = -(-N // dp_size)
     t_fit = (
         est_tree_seconds(
-            n_local, F, n_bins, cfg.max_depth, hist_subtract=dp_size == 1
+            n_local, F, n_bins, cfg.max_depth,
+            hist_subtract=cfg.hist_subtract and dp_size == 1,
         )
         * cfg.n_estimators
     )
@@ -293,6 +298,23 @@ def rfe_select(
         or compile_risky
     ):
         steps = 0
+    if steps and n_iters and (compile_risky or t_fit > DISPATCH_BUDGET_S):
+        # An explicit positive steps_per_dispatch overrides both guards — the
+        # K-step scan is a strictly LARGER program than the one-dispatch fit
+        # that crashed this environment's remote-compile service, and K fits
+        # past the budget can outrun the ~60s dispatch kill. Documented
+        # override, hard-crash failure mode: say so loudly.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "explicit steps_per_dispatch=%d bypasses the %s guard "
+            "(est. %.1fs/fit, budget %.0fs, %d x %d cells) — a dispatch "
+            "kill or remote-compile crash here is an environment limit, "
+            "not a bug",
+            steps,
+            "compile-risk" if compile_risky else "dispatch-budget",
+            t_fit, DISPATCH_BUDGET_S, n_local, F,
+        )
     if steps != 0:
         steps = min(
             steps or auto_steps_per_dispatch(n_iters, fit_seconds=t_fit),
@@ -320,7 +342,7 @@ def rfe_select(
             n_feats=F,
             n_bins=n_bins,
             depth=cfg.max_depth,
-            hist_subtract=dp_size == 1,
+            hist_subtract=cfg.hist_subtract and dp_size == 1,
         )
         if chunk is None and compile_risky:
             # Never compile the one-dispatch whole fit in the compile-risk
@@ -343,21 +365,23 @@ def rfe_select(
                 # makes shard_map a no-op, so skip it entirely here.
                 forest = fit_binned_chunked(
                     bins, y, sw, fm, hp, jax.random.fold_in(rng, it),
-                    chunk_trees=chunk, **kw,
+                    chunk_trees=chunk, hist_subtract=cfg.hist_subtract, **kw,
                 )
             elif chunk and mesh is not None:
                 forest = fit_binned_dp_chunked(
                     mesh, bins, y, sw, fm, hp, jax.random.fold_in(rng, it),
-                    chunk_trees=chunk, dp_axis=dp_axis, **kw,
+                    chunk_trees=chunk, dp_axis=dp_axis,
+                    hist_subtract=cfg.hist_subtract, **kw,
                 )
             elif mesh is not None:
                 forest = fit_binned_dp(
                     mesh, bins, y, sw, fm, hp, jax.random.fold_in(rng, it),
-                    dp_axis=dp_axis, **kw,
+                    dp_axis=dp_axis, hist_subtract=cfg.hist_subtract, **kw,
                 )
             else:
                 forest = fit_binned(
-                    bins, y, sw, fm, hp, jax.random.fold_in(rng, it), **kw
+                    bins, y, sw, fm, hp, jax.random.fold_in(rng, it),
+                    hist_subtract=cfg.hist_subtract, **kw,
                 )
             total_gain, _ = gain_importances(forest, F)
             imp = np.array(total_gain)  # copy: np.asarray of a jax array is read-only
@@ -414,6 +438,7 @@ def rfe_select(
                 feature_mask=jnp.asarray(fm_np),
                 dp_axis=dp_axis,
                 chunk_trees="auto",  # budget the fold fits like every other
+                hist_subtract=cfg.hist_subtract,
             )
             cv_scores[n] = float(np.asarray(aucs).mean())
             cv_masks[n] = fm_np.copy()

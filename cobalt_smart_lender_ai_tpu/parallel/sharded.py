@@ -65,10 +65,13 @@ def fit_binned_dp(
     depth_cap: int,
     n_bins: int,
     dp_axis: str = "dp",
+    hist_subtract: bool = True,
 ) -> Forest:
     """Data-parallel `fit_binned`: rows sharded over ``dp_axis``, histograms
     psum-reduced, forest replicated. Rows are zero-weight padded so the row
-    count divides the dp axis size."""
+    count divides the dp axis size. ``hist_subtract=False`` forces direct
+    histograms even on a 1-device dp axis (the cross-mesh bit-identity
+    escape hatch of GBDTConfig.hist_subtract); dp>1 is always direct."""
     bins, y, sw, fm, _ = _prep_dp_rows(
         mesh, bins, y, sample_weight, feature_mask, dp_axis
     )
@@ -96,7 +99,7 @@ def fit_binned_dp(
             # >1 device, psum reduction order + subtraction would flip
             # near-tie splits vs a single device, breaking the dp
             # bit-identity guarantee this module advertises.
-            hist_subtract=mesh.shape[dp_axis] == 1,
+            hist_subtract=hist_subtract and mesh.shape[dp_axis] == 1,
         )
 
     return jax.jit(_fit)(bins, y, sw, fm, hp, rng)
@@ -116,6 +119,7 @@ def fit_binned_dp_chunked(
     n_bins: int,
     chunk_trees: int,
     dp_axis: str = "dp",
+    hist_subtract: bool = True,
 ) -> Forest:
     """`fit_binned_dp` split into ``chunk_trees``-round dispatches with the
     margin carried between them (row-sharded, like the training data) —
@@ -129,7 +133,7 @@ def fit_binned_dp_chunked(
         return fit_binned_dp(
             mesh, bins, y, sample_weight, feature_mask, hp, rng,
             n_trees_cap=n_trees_cap, depth_cap=depth_cap, n_bins=n_bins,
-            dp_axis=dp_axis,
+            dp_axis=dp_axis, hist_subtract=hist_subtract,
         )
     bins, y, sw, fm, n_total = _prep_dp_rows(
         mesh, bins, y, sample_weight, feature_mask, dp_axis
@@ -165,7 +169,7 @@ def fit_binned_dp_chunked(
             axis_name=dp_axis,
             init_margin=m_l,
             tree_offset=off_l,
-            hist_subtract=mesh.shape[dp_axis] == 1,  # see fit_binned_dp
+            hist_subtract=hist_subtract and mesh.shape[dp_axis] == 1,
         )
 
     from cobalt_smart_lender_ai_tpu.debug import retry_first_dispatch

@@ -162,6 +162,7 @@ def cross_validate_gbdt(
     dp_axis: str = "dp",
     cand_ids: jax.Array | None = None,
     chunk_trees: int | str | None = None,
+    hist_subtract: bool = True,
 ) -> jax.Array:
     """Validation ROC-AUC for every (candidate, fold) job, shape ``(C, K)``.
 
@@ -182,6 +183,10 @@ def cross_validate_gbdt(
     ``cand_id * K + fold``, so a caller dispatching candidate subsets (the
     depth-bucketed search) reproduces the joint dispatch's subsample /
     colsample draws — and therefore its scores — exactly.
+
+    ``hist_subtract=False`` forces direct histograms even on one device
+    (GBDTConfig.hist_subtract's cross-mesh bit-identity escape hatch);
+    dp>1 always runs direct regardless — see fit_binned_resumable.
     """
     C = jax.tree.leaves(hps)[0].shape[0]
     K, N = val_masks.shape
@@ -215,6 +220,7 @@ def cross_validate_gbdt(
     # padded rows with weight 1). Row validity and the caller's sample_weight
     # ride the same vector.
     dp_size = mesh.shape[dp_axis]
+    hist_subtract = hist_subtract and dp_size == 1
     if chunk_trees is not None:
         from cobalt_smart_lender_ai_tpu.parallel.budget import (
             resolve_chunk_trees,
@@ -228,7 +234,7 @@ def cross_validate_gbdt(
             n_bins=n_bins,
             depth=depth_cap,
             n_jobs=n_jobs_padded // hp_size,
-            hist_subtract=dp_size == 1,
+            hist_subtract=hist_subtract,
         )
     n_total = N + pad_rows(N, dp_size)
     bins_p = _pad_to(bins, n_total, 0)
@@ -282,8 +288,9 @@ def cross_validate_gbdt(
                     init_margin=m0,
                     tree_offset=off_l,
                     # dp>1 keeps the slower direct histograms so scores stay
-                    # bit-identical to a single device (see fit_binned_dp).
-                    hist_subtract=dp_size == 1,
+                    # bit-identical to a single device (see fit_binned_dp);
+                    # the caller can force direct mode on one device too.
+                    hist_subtract=hist_subtract,
                 )
                 return m1
 
@@ -418,6 +425,7 @@ def randomized_search(
             feature_mask=fm,
             cand_ids=jnp.asarray(idxs, jnp.int32),
             chunk_trees=tune.chunk_trees,
+            hist_subtract=base.hist_subtract,
         )
         split_scores[idxs] = np.asarray(aucs)
     mean_auc = split_scores.mean(axis=1)

@@ -100,7 +100,8 @@ def test_retry_first_dispatch_policy():
         calls["n"] += 1
         if calls["n"] == 1:
             raise jax.errors.JaxRuntimeError(
-                "INTERNAL: http://x/remote_compile: read body closed"
+                "INTERNAL: http://x/remote_compile: response body closed "
+                "before all bytes were read"
             )
         return "ok"
 
@@ -111,7 +112,9 @@ def test_retry_first_dispatch_policy():
     assert out == "ok" and calls == {"n": 2, "rebuilt": 1}
 
     def always():
-        raise jax.errors.JaxRuntimeError("remote_compile: read body closed")
+        raise jax.errors.JaxRuntimeError(
+            "remote_compile: response body closed before all bytes were read"
+        )
 
     with pytest.raises(jax.errors.JaxRuntimeError):  # not first -> no retry
         retry_first_dispatch(always, lambda: None, is_first=False)
@@ -121,6 +124,27 @@ def test_retry_first_dispatch_policy():
             lambda: None,
             is_first=True,
         )
+
+
+def test_transient_match_requires_rpc_symptom():
+    """A deterministic compiler failure that merely MENTIONS remote_compile
+    must fail fast (no 3x retry) — only the RPC channel-death symptoms are
+    transient."""
+    from cobalt_smart_lender_ai_tpu.debug import is_transient_compile_error
+
+    rpc = jax.errors.JaxRuntimeError(
+        "INTERNAL: http://x/remote_compile: response body closed before "
+        "all bytes were read"
+    )
+    assert is_transient_compile_error(rpc)
+    assert is_transient_compile_error(
+        jax.errors.JaxRuntimeError("remote_compile: UNAVAILABLE: connection reset")
+    )
+    deterministic = jax.errors.JaxRuntimeError(
+        "INVALID_ARGUMENT: remote_compile failed: HLO verification error"
+    )
+    assert not is_transient_compile_error(deterministic)
+    assert not is_transient_compile_error(ValueError("response body closed"))
 
 
 def test_force_virtual_cpu_devices_is_idempotent_on_cpu():

@@ -298,6 +298,32 @@ def test_rfecv_scores_and_held_out_auc():
     assert fit_auc(cv.support_) >= fit_auc(plain.support_) - 0.01
 
 
+@pytest.mark.parametrize("steps", [None, 0])  # device-stepped / host-stepped
+def test_hist_subtract_false_gives_cross_mesh_identical_rfe(steps):
+    """The GBDTConfig/RFEConfig ``hist_subtract=False`` escape hatch must make
+    a single-device run bit-identical to a dp>1 run of the same config+seed —
+    the advertised cross-mesh reproducibility contract (the knob has to reach
+    the fan-out loops — including the host-stepped fit_binned_dp branch —
+    not just GBDTClassifier.fit)."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(600, 10)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 3] + rng.normal(0, 1, 600) > 0).astype(np.int32)
+    cfg = RFEConfig(
+        n_select=4, step=2, n_estimators=10, max_depth=3, hist_subtract=False,
+        steps_per_dispatch=steps,
+    )
+    res_1 = rfe_select(
+        X, y, cfg,
+        mesh=make_mesh(MeshConfig(dp=1, hp=1), devices=jax.devices()[:1]),
+    )
+    res_dp = rfe_select(
+        X, y, cfg,
+        mesh=make_mesh(MeshConfig(dp=4, hp=1), devices=jax.devices()[:4]),
+    )
+    np.testing.assert_array_equal(res_1.support_, res_dp.support_)
+    np.testing.assert_array_equal(res_1.ranking_, res_dp.ranking_)
+
+
 def test_hist_subtraction_quality_matches_direct(small_binned):
     """Sibling subtraction (the single-device fast path) may flip near-tie
     splits vs direct histograms, but the fitted model's quality must be

@@ -1,0 +1,326 @@
+"""Attribute the depth-9 search bucket's ~1.27 s/tree by ablation.
+
+VERDICT r4 weakness #1: the 33-job depth-9 bucket at 130k x 20 x 255 bins
+costs ~1.27 s/tree (with sibling subtraction) and the round-4 calibration
+notes could not attribute ~1 s of it — the node-one-hot contraction alone
+measured near MXU peak. `jax.profiler` device traces are unreliable over
+this environment's tunneled backend, so this tool isolates each stage of
+the per-level histogram pass by timing purpose-built variants of the SAME
+block-scan structure (`ops/histogram.py _hist_matmul`) at the real bucket
+shape:
+
+    full        the real vmapped fit (fit_binned_resumable, hist_subtract)
+    hist        histogram passes only (9 levels/tree, fixed node maps; no
+                split eval / routing) — the budget model's A+B terms
+    dot         contraction only: bin-one-hot AND rhs precomputed outside
+                the timed scan (reads them from HBM instead of building)
+    dot_bf16    `dot` with the rhs cast to bf16 — isolates any f32-operand
+                MXU rate penalty
+    onehot      bin-one-hot build + a trivial width-1 contraction — the
+                one-hot construction stream without the real dot
+    rhs         node-one-hot x (g|h|w) rhs build + trivial contraction
+    route       split-eval chain (cumsum/argmax) + select_columns routing
+                on precomputed histograms — everything that is NOT the
+                histogram pass
+
+Each variant is jitted once, warmed, and timed best-of-2 with the result
+fetched as a scalar (block_until_ready lies over the tunnel). Timed regions
+are sized >= ~10 s so the seconds-scale RPC jitter stays small. Prints one
+JSON line per variant plus a derived attribution summary.
+
+Usage:  python tools/ablate_d9.py [--rows 130000] [--jobs 33] [--trees 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+# Real bucket shape: depth-9 candidates of the reference search space at the
+# 130k-row parity scale (PARITY.json), 20 RFE-selected features, 255 bins.
+DEPTH = 9
+N_BINS = 255
+N_FEATS = 20
+ROW_BLOCK = 4096
+
+# Sibling-subtraction contraction widths per level (left children only at
+# parent width; level 0 direct) — models/gbdt.py fit_binned_resumable.
+WIDTHS = [1] + [2 ** (lvl - 1) for lvl in range(1, DEPTH)]
+
+
+def timed(fn, *args, reps: int = 2) -> float:
+    """Best-of-`reps` wall seconds; forces execution via a scalar fetch."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.time()
+        out = fn(*args)
+        float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+        best = min(best, time.time() - t0)
+    return best
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=130_000)
+    ap.add_argument("--jobs", type=int, default=33)
+    ap.add_argument("--trees", type=int, default=8)
+    ap.add_argument(
+        "--only", default=None,
+        help="comma-separated variant names to run (default: all)",
+    )
+    args = ap.parse_args()
+    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
+    N, J, T = args.rows, args.jobs, args.trees
+    F, B = N_FEATS, N_BINS
+    rng = np.random.default_rng(0)
+    bins = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
+    ghw = jnp.asarray(rng.normal(size=(J, 3, N)).astype(np.float32))
+    # Fixed per-level node maps (uniform over the level's width): cost-faithful
+    # stand-ins for the data-dependent routing of a real fit.
+    nodes = [
+        jnp.asarray(rng.integers(0, k, size=(N,), dtype=np.int32)) for k in WIDTHS
+    ]
+
+    n_blocks = -(-N // ROW_BLOCK)
+    pad = n_blocks * ROW_BLOCK - N
+
+    def _blocked(v, fill=0):
+        v = jnp.pad(v, [(0, pad)] + [(0, 0)] * (v.ndim - 1)) if pad else v
+        return v.reshape((n_blocks, ROW_BLOCK) + v.shape[1:])
+
+    bins_b = _blocked(bins)  # (nb, R, F)
+    iota = jnp.arange(B, dtype=jnp.int32)
+
+    results: dict[str, float] = {}
+    known = {"full", "hist", "dot", "dot_bf16", "onehot", "rhs", "route"}
+    want = set(args.only.split(",")) if args.only else None
+    if want is not None and not want <= known:
+        ap.error(f"unknown variant(s) {sorted(want - known)}; known: {sorted(known)}")
+
+    def record(name: str, seconds: float, per_tree_jobs: float) -> None:
+        results[name] = seconds
+        print(json.dumps({
+            "variant": name,
+            "seconds": round(seconds, 3),
+            "s_per_tree": round(per_tree_jobs, 4),
+            "shape": f"{N}x{F}x{B} J={J} T={T} depth={DEPTH}",
+        }), flush=True)
+
+    # ---- full: the real fit ------------------------------------------------
+    if want is None or "full" in want:
+        from cobalt_smart_lender_ai_tpu.models.gbdt import (
+            GBDTHyperparams,
+            fit_binned,
+        )
+        from cobalt_smart_lender_ai_tpu.config import GBDTConfig
+
+        hp = GBDTHyperparams.from_config(
+            GBDTConfig(n_estimators=T, max_depth=DEPTH, n_bins=B)
+        )
+        hps = jax.tree.map(lambda a: jnp.broadcast_to(a, (J,) + a.shape), hp)
+        y = jnp.asarray((rng.random(N) < 0.2).astype(np.int32))
+        sw = jnp.ones((N,), jnp.float32)
+        fm = jnp.ones((F,), bool)
+        keys = jax.random.split(jax.random.PRNGKey(0), J)
+
+        @jax.jit
+        def full(hps, keys):
+            def one(hp_j, key):
+                f = fit_binned(
+                    bins, y, sw, fm, hp_j, key,
+                    n_trees_cap=T, depth_cap=DEPTH, n_bins=B,
+                )
+                return f.leaf_value.sum()
+
+            return jax.vmap(one)(hps, keys)
+
+        t = timed(full, hps, keys)
+        record("full", t, t / T)
+
+    # ---- shared scan-variant builder --------------------------------------
+    # Every variant runs T sequential "trees" x 9 levels of block-scans with
+    # a scalar carried across trees (prevents cross-tree batching), vmapped
+    # over J jobs exactly like the real fan-out (bins shared, ghw per job).
+    def run_levels(tag, level_fn, extras=(), per_level_extras=None, jobs=J):
+        """level_fn(carry_scalar, level_idx, ghw_j, *extras) -> scalar."""
+
+        @jax.jit
+        def run(ghw_all, *extra_args):
+            def one_job(ghw_j):
+                def tree_step(carry, _):
+                    s = carry
+                    for lvl in range(DEPTH):
+                        ex = (
+                            tuple(e[lvl] for e in per_level_extras)
+                            if per_level_extras
+                            else ()
+                        )
+                        s = level_fn(s, lvl, ghw_j, *extra_args, *ex)
+                    return s, None
+
+                out, _ = jax.lax.scan(
+                    tree_step, jnp.float32(0.0), jnp.arange(T)
+                )
+                return out
+
+            return jax.vmap(one_job)(ghw_all)
+
+        t = timed(run, ghw[:jobs], *extras)
+        record(tag, t, t / T)
+
+    # ---- hist: the 9 real histogram passes per tree ------------------------
+    if want is None or "hist" in want:
+        from cobalt_smart_lender_ai_tpu.ops.histogram import gradient_histogram
+
+        def hist_level(s, lvl, ghw_j):
+            g = ghw_j[0] * (1.0 + 1e-12 * s)  # serialize trees via the carry
+            h = gradient_histogram(
+                bins, nodes[lvl], g, ghw_j[1], ghw_j[2],
+                n_nodes=WIDTHS[lvl], n_bins=B, row_block=ROW_BLOCK,
+            )
+            return s + h.sum()
+
+        run_levels("hist", hist_level)
+
+    # ---- dot / dot_bf16: contraction with both operands precomputed --------
+    oh_pre = (bins_b[..., None].astype(jnp.int32) == iota).astype(jnp.bfloat16)
+    # (nb, R, F, B) bf16 — ~1.3GB at 130k; read from HBM by the timed scan.
+
+    def make_dot(rhs_dtype):
+        def dot_level(s, lvl, ghw_j, oh_all):
+            K = WIDTHS[lvl]
+            oh_node = jax.nn.one_hot(nodes[lvl], K, dtype=jnp.float32)
+            rhs = (oh_node[:, None, :] * ghw_j.T[:, :, None]).reshape(N, 3 * K)
+            rhs = (rhs * (1.0 + 1e-12 * s)).astype(rhs_dtype)
+            rhs_b = _blocked(rhs)
+
+            def body(acc, xs):
+                oh_blk, r_blk = xs
+                return acc + jnp.einsum(
+                    "rfb,rk->fbk", oh_blk, r_blk,
+                    preferred_element_type=jnp.float32,
+                ), None
+
+            acc, _ = jax.lax.scan(
+                body,
+                jnp.zeros((F, B, 3 * K), jnp.float32),
+                (oh_all, rhs_b),
+            )
+            return s + acc.sum()
+
+        return dot_level
+
+    if want is None or "dot" in want:
+        run_levels("dot", make_dot(jnp.float32), extras=(oh_pre,))
+    if want is None or "dot_bf16" in want:
+        run_levels("dot_bf16", make_dot(jnp.bfloat16), extras=(oh_pre,))
+
+    # ---- onehot: build the bin one-hot, contract to width 1 ----------------
+    if want is None or "onehot" in want:
+        ones_r = jnp.ones((ROW_BLOCK, 1), jnp.bfloat16)
+
+        def onehot_level(s, lvl, ghw_j):
+            scale = (ghw_j[0, 0] * 1e-12 + 1.0).astype(jnp.bfloat16)
+
+            def body(acc, bblk):
+                oh = (
+                    bblk[..., None].astype(jnp.int32) == iota
+                ).astype(jnp.bfloat16) * scale
+                return acc + jnp.einsum(
+                    "rfb,rk->fbk", oh, ones_r,
+                    preferred_element_type=jnp.float32,
+                ), None
+
+            acc, _ = jax.lax.scan(
+                body, jnp.zeros((F, B, 1), jnp.float32), bins_b
+            )
+            return s + acc.sum() * (1.0 + 1e-12 * s)
+
+        run_levels("onehot", onehot_level)
+
+    # ---- rhs: build the node-one-hot rhs, contract to width 1 --------------
+    if want is None or "rhs" in want:
+        ones_fb = jnp.ones((ROW_BLOCK, 1), jnp.bfloat16)
+
+        def rhs_level(s, lvl, ghw_j):
+            K = WIDTHS[lvl]
+            oh_node = jax.nn.one_hot(nodes[lvl], K, dtype=jnp.float32)
+            rhs = (oh_node[:, None, :] * ghw_j.T[:, :, None]).reshape(N, 3 * K)
+            rhs = rhs * (1.0 + 1e-12 * s)
+            rhs_b = _blocked(rhs)
+
+            def body(acc, r_blk):
+                return acc + jnp.einsum(
+                    "rk,rc->kc", r_blk, ones_fb.astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                ), None
+
+            acc, _ = jax.lax.scan(
+                body, jnp.zeros((3 * K, 1), jnp.float32), rhs_b
+            )
+            return s + acc.sum()
+
+        run_levels("rhs", rhs_level)
+
+    # ---- route: split-eval chain + routing on precomputed histograms ------
+    if want is None or "route" in want:
+        from cobalt_smart_lender_ai_tpu.ops.histogram import select_columns
+
+        hists = [
+            jnp.asarray(
+                rng.normal(size=(2 ** lvl, F, B, 2)).astype(np.float32)
+            )
+            for lvl in range(DEPTH)
+        ]
+
+        def route_level(s, lvl, ghw_j, hist_l):
+            n_nodes = 2 ** lvl
+            hist = hist_l * (1.0 + 1e-12 * s)
+            miss = hist[:, :, 0, :]
+            cum = jnp.cumsum(hist[:, :, 1:, :], axis=2)
+            tot = cum[:, :, -1, :] + miss
+            GL = cum[..., :-1, 0]
+            HL = cum[..., :-1, 1]
+            Gt = tot[..., 0][:, :, None]
+            Ht = tot[..., 1][:, :, None]
+            gain = GL * GL / (HL + 1.0) + (Gt - GL) ** 2 / (Ht - HL + 1.0)
+            flat = gain.reshape(n_nodes, -1)
+            best = jnp.argmax(flat, axis=1)
+            bf = (best // (B - 2)).astype(jnp.int32)
+            bt = (best % (B - 2)).astype(jnp.int32) + 1
+            node = nodes[lvl]
+            b_row = select_columns(bins, bf[node], exact_max=B).astype(jnp.int32)
+            go_left = b_row <= bt[node]
+            return s + go_left.sum().astype(jnp.float32)
+
+        run_levels("route", route_level, per_level_extras=(hists,))
+
+    # ---- attribution summary ----------------------------------------------
+    if results:
+        print(json.dumps({
+            "summary": {k: round(v / T, 4) for k, v in results.items()},
+            "note": (
+                "s/tree per variant; hist ~ A+B budget terms; "
+                "dot = contraction with operands precomputed; "
+                "onehot/rhs = operand builds with trivial dots; "
+                "route = split eval + routing (non-histogram)"
+            ),
+        }, indent=None), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,18 @@ from bench import NORTH_STAR_ROWS_PER_SEC_PER_CHIP  # single source of truth
 CHUNK_TREES = "auto"
 
 
+def _atomic_write(path: Path, text: str) -> None:
+    """Write-then-rename so a stage killed mid-write (run_protocol.sh wraps
+    every stage in `timeout`) can never leave a truncated file that passes
+    the shell's [ -f ] resume gate — the retry loop would skip the stage and
+    a later stage would crash parsing corrupt JSON."""
+    import os
+
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(text)
+    os.replace(tmp, path)
+
+
 def _buckets(candidates, base):
     """Search stages: `parallel.tune.search_buckets`' EXACT bucketing (shared
     helper, so stage indices can never drift from the joint dispatch's), with
@@ -128,14 +140,20 @@ def stage_prep(args):
 
     out = Path(args.dir)
     out.mkdir(parents=True, exist_ok=True)
+    import os
+
     np.savez_compressed(
-        out / "prep.npz",
+        out / "prep.tmp.npz",  # savez appends .npz unless already present
         Xtr=Xtr,
         Xte=Xte,
         y_train=np.asarray(y_train, np.int32),
         y_test=np.asarray(y_test, np.int32),
     )
-    (out / "prep.json").write_text(
+    # npz first, json (the resume gate) last — both atomically, so the gate
+    # file existing implies a complete npz.
+    os.replace(out / "prep.tmp.npz", out / "prep.npz")
+    _atomic_write(
+        out / "prep.json",
         json.dumps(
             {
                 "rows": args.rows,
@@ -213,7 +231,7 @@ def stage_search(args, stage_idx: int):
         "scores": np.asarray(aucs).tolist(),
         "seconds": wall,
     }
-    (Path(args.dir) / f"search{stage_idx}.json").write_text(json.dumps(out))
+    _atomic_write(Path(args.dir) / f"search{stage_idx}.json", json.dumps(out))
     print(json.dumps(out))
 
 
@@ -274,7 +292,7 @@ def stage_final(args):
     }
     print(json.dumps(doc))
     if args.out:
-        Path(args.out).write_text(json.dumps(doc, indent=2))
+        _atomic_write(Path(args.out), json.dumps(doc, indent=2))
 
 
 def stage_list():
