@@ -137,6 +137,7 @@ def synthetic_lendingclub_frame(
         return np.array([f"{_MONTHS[mm]}-{yy}" for mm, yy in zip(m, y)])
 
     frame = {
+        "Unnamed: 0.1": np.arange(n) + 1_000_000,  # second index artifact
         "Unnamed: 0": np.arange(n),
         "id": 10_000_000 + np.arange(n),
         "url": np.array(["https://lendingclub.com/loan/%d" % i for i in range(n)]),
@@ -146,7 +147,12 @@ def synthetic_lendingclub_frame(
         "addr_state": rng.choice(["CA", "NY", "TX", "FL", "IL", "WA"], n),
         "emp_title": rng.choice(["Teacher", "Manager", "Driver", "Nurse", "Engineer",
                                  "Owner", ""], n),
-        "emp_length": np.array(schema.EMP_LENGTHS, dtype=object)[emp_len_idx],
+        # ~7% missing like the real table (cell 26: 6,950/100,000) -> the NN
+        # path imputes emp_length_num and adds its _NA indicator (cell 18).
+        "emp_length": np.where(
+            rng.random(n) < 0.07, None,
+            np.array(schema.EMP_LENGTHS, dtype=object)[emp_len_idx],
+        ),
         "issue_d": _date_str(rng.integers(30, 4000, n).astype(float)),
         "earliest_cr_line": _date_str(cr_age_days),
         "initial_list_status": rng.choice(["w", "f"], n),
@@ -168,17 +174,18 @@ def synthetic_lendingclub_frame(
         "fico_range_high": fico_high,
         "last_fico_range_high": last_fico_high,
         "last_fico_range_low": np.clip(last_fico_high - 4, 300, 850),
-        "revol_util": np.array([f"{u * 100:.1f}%" for u in revol_util], dtype=object),
+        "revol_util": np.where(
+            rng.random(n) < 0.004, None,
+            np.array([f"{u * 100:.1f}%" for u in revol_util], dtype=object),
+        ),
         "revol_bal": np.clip(_lognormal(rng, 9.2, 1.1, n), 0, 500_000).round(0),
         "open_acc": open_acc.astype(float),
         "total_acc": total_acc.astype(float),
         "mort_acc": mort_acc.astype(float),
         "pub_rec": (pub_rec_bankruptcies + (rng.random(n) < 0.05)).round(0),
         "pub_rec_bankruptcies": pub_rec_bankruptcies,
-        "open_il_12m": open_il_12m,
-        "open_il_24m": open_il_24m,
-        "max_bal_bc": max_bal_bc,
-        "num_rev_accts": num_rev_accts,
+        # open_il_12m/open_il_24m/max_bal_bc/num_rev_accts join the blocked
+        # updates below (shared-missingness structure).
         "loan_status": status,
         "application_type": rng.choice(schema.APPLICATION_TYPES, n, p=[0.95, 0.05]),
         "home_ownership": rng.choice(schema.HOME_OWNERSHIP, n,
@@ -204,42 +211,133 @@ def synthetic_lendingclub_frame(
         "out_prncp_inv": (loan_amnt * (1 - paid_frac) * 0.99).round(2),
         # Extra numerics from the log-transform list (feature_engineering.py:118-130)
         "acc_now_delinq": rng.poisson(0.02, n).astype(float),
-        "tot_coll_amt": np.where(rng.random(n) < 0.12,
-                                 _lognormal(rng, 6, 1.3, n), 0.0).round(0),
-        "tot_cur_bal": np.clip(_lognormal(rng, 11.4, 1.0, n), 0, 3e6).round(0),
-        "total_rev_hi_lim": np.clip(_lognormal(rng, 10.1, 0.9, n), 0, 1e6).round(0),
-        "acc_open_past_24mths": rng.poisson(4, n).astype(float),
-        "avg_cur_bal": np.clip(_lognormal(rng, 9.1, 1.0, n), 0, 5e5).round(0),
-        "bc_open_to_buy": np.clip(_lognormal(rng, 8.8, 1.3, n), 0, 4e5).round(0),
-        "mo_sin_old_rev_tl_op": np.clip(rng.normal(180, 90, n), 2, 800).round(0),
-        "mo_sin_rcnt_rev_tl_op": rng.exponential(14, n).round(0),
-        "mo_sin_rcnt_tl": rng.exponential(8, n).round(0),
-        "num_accts_ever_120_pd": rng.poisson(0.5, n).astype(float),
-        "num_actv_bc_tl": rng.poisson(3.7, n).astype(float),
-        "num_actv_rev_tl": rng.poisson(5.6, n).astype(float),
-        "num_bc_sats": rng.poisson(4.7, n).astype(float),
-        "num_bc_tl": rng.poisson(7.7, n).astype(float),
-        "num_il_tl": rng.poisson(8.4, n).astype(float),
-        "num_op_rev_tl": rng.poisson(8.2, n).astype(float),
-        "num_rev_tl_bal_gt_0": rng.poisson(5.6, n).astype(float),
-        "num_sats": rng.poisson(11.6, n).astype(float),
-        "num_tl_op_past_12m": rng.poisson(2.1, n).astype(float),
-        "tot_hi_cred_lim": np.clip(_lognormal(rng, 11.8, 0.9, n), 0, 4e6).round(0),
-        "total_bal_ex_mort": np.clip(_lognormal(rng, 10.6, 0.9, n), 0, 1.5e6).round(0),
-        "total_bc_limit": np.clip(_lognormal(rng, 9.7, 1.0, n), 0, 6e5).round(0),
-        "total_il_high_credit_limit": np.clip(
-            _lognormal(rng, 10.4, 1.0, n), 0, 1.5e6).round(0),
-        "pct_tl_nvr_dlq": np.clip(rng.normal(94, 8, n), 20, 100).round(1),
-        "percent_bc_gt_75": np.clip(rng.normal(40, 34, n), 0, 100).round(1),
         "delinq_2yrs": rng.poisson(0.3, n).astype(float),
         "inq_last_6mths": rng.poisson(0.6, n).astype(float),
-        # Columns cleaned by FILL_ZERO_COLS (clean_data.py:140) — inject NaNs.
-        "inq_last_12m": np.where(rng.random(n) < 0.3, np.nan,
-                                 rng.poisson(2, n).astype(float)),
-        "open_acc_6m": np.where(rng.random(n) < 0.3, np.nan,
-                                rng.poisson(1, n).astype(float)),
+        # Dense low-information columns present in the raw table
+        # (01_data_cleaning.ipynb cell 26: 0 nulls).
+        "policy_code": np.ones(n),
+        "delinq_amnt": np.where(rng.random(n) < 0.01,
+                                _lognormal(rng, 7, 1, n), 0.0).round(0),
+        "collections_12_mths_ex_med": rng.poisson(0.02, n).astype(float),
+        "tax_liens": rng.poisson(0.05, n).astype(float),
+        # hardship_status: mostly missing → filled "No Hardship" (clean_data.py:116-118)
+        "hardship_status": np.where(
+            rng.random(n) < 0.95, None,
+            rng.choice(["ACTIVE", "BROKEN", "COMPLETE", "COMPLETED"], n)),
+    }
+
+    # --- Bureau-history block (shared ~2.4% missingness) ---------------------
+    # In the real table (01_data_cleaning.ipynb cell 26) a ~2.4% row subset
+    # misses the whole credit-bureau block at once; those rows then miss >20
+    # columns and are dropped by the row-null allowance
+    # (feature_engineering.py:66) — 99,995 -> 97,557 rows. Reproducing the
+    # BLOCK structure (one shared mask, nested sub-blocks) reproduces that
+    # row-drop behavior; independent per-column masks would not.
+    m_core = rng.random(n) < 0.0244
+    m_sats = m_core & (rng.random(n) < 0.84)  # num_bc_sats/num_sats subset
+    m_1778 = m_sats & (rng.random(n) < 0.87)  # acc_open.../mort_acc subset
+
+    def _blocked_col(vals: np.ndarray, mask: np.ndarray) -> np.ndarray:
+        return np.where(mask, np.nan, vals)
+
+    frame.update({
+        "tot_coll_amt": _blocked_col(
+            np.where(rng.random(n) < 0.12,
+                     _lognormal(rng, 6, 1.3, n), 0.0).round(0), m_core),
+        "tot_cur_bal": _blocked_col(
+            np.clip(_lognormal(rng, 11.4, 1.0, n), 0, 3e6).round(0), m_core),
+        "total_rev_hi_lim": _blocked_col(
+            np.clip(_lognormal(rng, 10.1, 0.9, n), 0, 1e6).round(0), m_core),
+        "mo_sin_old_rev_tl_op": _blocked_col(
+            np.clip(rng.normal(180, 90, n), 2, 800).round(0), m_core),
+        "mo_sin_rcnt_rev_tl_op": _blocked_col(
+            rng.exponential(14, n).round(0), m_core),
+        "mo_sin_rcnt_tl": _blocked_col(rng.exponential(8, n).round(0), m_core),
+        "num_accts_ever_120_pd": _blocked_col(
+            rng.poisson(0.5, n).astype(float), m_core),
+        "num_actv_bc_tl": _blocked_col(rng.poisson(3.7, n).astype(float), m_core),
+        "num_actv_rev_tl": _blocked_col(rng.poisson(5.6, n).astype(float), m_core),
+        "num_bc_tl": _blocked_col(rng.poisson(7.7, n).astype(float), m_core),
+        "num_il_tl": _blocked_col(rng.poisson(8.4, n).astype(float), m_core),
+        "num_op_rev_tl": _blocked_col(rng.poisson(8.2, n).astype(float), m_core),
+        "num_rev_accts": _blocked_col(num_rev_accts, m_core),
+        "num_rev_tl_bal_gt_0": _blocked_col(
+            rng.poisson(5.6, n).astype(float), m_core),
+        "num_tl_30dpd": _blocked_col(rng.poisson(0.03, n).astype(float), m_core),
+        "num_tl_90g_dpd_24m": _blocked_col(
+            rng.poisson(0.08, n).astype(float), m_core),
+        "num_tl_op_past_12m": _blocked_col(
+            rng.poisson(2.1, n).astype(float), m_core),
+        "tot_hi_cred_lim": _blocked_col(
+            np.clip(_lognormal(rng, 11.8, 0.9, n), 0, 4e6).round(0), m_core),
+        "total_il_high_credit_limit": _blocked_col(
+            np.clip(_lognormal(rng, 10.4, 1.0, n), 0, 1.5e6).round(0), m_core),
+        "num_bc_sats": _blocked_col(rng.poisson(4.7, n).astype(float), m_sats),
+        "num_sats": _blocked_col(rng.poisson(11.6, n).astype(float), m_sats),
+        "acc_open_past_24mths": _blocked_col(
+            rng.poisson(4, n).astype(float), m_1778),
+        "total_bal_ex_mort": _blocked_col(
+            np.clip(_lognormal(rng, 10.6, 0.9, n), 0, 1.5e6).round(0), m_1778),
+        "total_bc_limit": _blocked_col(
+            np.clip(_lognormal(rng, 9.7, 1.0, n), 0, 6e5).round(0), m_1778),
+        # Core-block members with small extra independent missingness, so the
+        # NN path still sees surviving NaNs (-> _NA indicators, cell 18) after
+        # the core rows are dropped.
+        "avg_cur_bal": _blocked_col(
+            np.clip(_lognormal(rng, 9.1, 1.0, n), 0, 5e5).round(0),
+            m_core | (rng.random(n) < 0.005)),
+        "bc_open_to_buy": _blocked_col(
+            np.clip(_lognormal(rng, 8.8, 1.3, n), 0, 4e5).round(0),
+            m_core | (rng.random(n) < 0.005)),
+        "pct_tl_nvr_dlq": _blocked_col(
+            np.clip(rng.normal(94, 8, n), 20, 100).round(1),
+            m_core | (rng.random(n) < 0.005)),
+        "percent_bc_gt_75": _blocked_col(
+            np.clip(rng.normal(40, 34, n), 0, 100).round(1),
+            m_core | (rng.random(n) < 0.005)),
+        "bc_util": _blocked_col(
+            np.clip(rng.normal(57, 28, n), 0, 200).round(1),
+            m_core | (rng.random(n) < 0.005)),
+        "mo_sin_old_il_acct": _blocked_col(
+            np.clip(rng.normal(130, 60, n), 1, 600).round(0),
+            m_core | (rng.random(n) < 0.03)),
+        "num_tl_120dpd_2m": _blocked_col(
+            rng.poisson(0.01, n).astype(float),
+            m_core | (rng.random(n) < 0.03)),
+    })
+
+    # --- Installment/revolving detail block (shared ~29.6% missingness) ------
+    # Pre-2015 originations lack these fields entirely, so they go missing
+    # TOGETHER (cell 26: 29,644 nulls across the whole block). Survivors of
+    # the row-null allowance keep these NaNs -> imputed + _NA indicators on
+    # the NN path (03_feature_engineering.ipynb cell 18).
+    m_il = rng.random(n) < 0.296
+    frame.update({
+        "open_act_il": _blocked_col(rng.poisson(2.4, n).astype(float), m_il),
+        "open_il_12m": _blocked_col(open_il_12m, m_il),
+        "open_il_24m": _blocked_col(open_il_24m.astype(float), m_il),
+        "mths_since_rcnt_il": _blocked_col(
+            rng.exponential(16, n).round(0), m_il),
+        "total_bal_il": _blocked_col(
+            np.clip(_lognormal(rng, 10.0, 1.1, n), 0, 1e6).round(0), m_il),
+        "open_rv_12m": _blocked_col(rng.poisson(1.3, n).astype(float), m_il),
+        "open_rv_24m": _blocked_col(rng.poisson(2.5, n).astype(float), m_il),
+        "max_bal_bc": _blocked_col(max_bal_bc, m_il),
+        "inq_fi": _blocked_col(rng.poisson(1.1, n).astype(float), m_il),
+        "total_cu_tl": _blocked_col(rng.poisson(1.5, n).astype(float), m_il),
+        # FILL_ZERO_COLS ride the same block (clean_data.py:140 fills them).
+        "inq_last_12m": _blocked_col(rng.poisson(2, n).astype(float), m_il),
+        "open_acc_6m": _blocked_col(rng.poisson(1, n).astype(float), m_il),
         "chargeoff_within_12_mths": np.where(rng.random(n) < 0.05, np.nan, 0.0),
-        # Sparse columns with moderate missingness (exercise NaN-aware GBDT).
+        # il_util/all_util: the block plus extra (cell 26: 39.7% / 29.7%) —
+        # both dropped as "unnecessary" during cleaning either way.
+        "il_util": _blocked_col(
+            rng.normal(0.7, 0.2, n).round(3), m_il | (rng.random(n) < 0.14)),
+        "all_util": _blocked_col(rng.normal(0.6, 0.2, n).round(3), m_il),
+    })
+
+    # --- Moderately sparse month-since columns (independent missingness) -----
+    frame.update({
         "mths_since_last_delinq": np.where(rng.random(n) < 0.5, np.nan,
                                            rng.exponential(34, n).round(0)),
         "mths_since_recent_bc": np.where(rng.random(n) < 0.1, np.nan,
@@ -250,15 +348,88 @@ def synthetic_lendingclub_frame(
             rng.random(n) < 0.67, np.nan, rng.exponential(35, n).round(0)),
         "mths_since_recent_bc_dlq": np.where(
             rng.random(n) < 0.77, np.nan, rng.exponential(39, n).round(0)),
-        "il_util": np.where(rng.random(n) < 0.75, np.nan,
-                            rng.normal(0.7, 0.2, n).round(3)),
-        "all_util": np.where(rng.random(n) < 0.75, np.nan,
-                             rng.normal(0.6, 0.2, n).round(3)),
-        # hardship_status: mostly missing → filled "No Hardship" (clean_data.py:116-118)
-        "hardship_status": np.where(
-            rng.random(n) < 0.95, None,
-            rng.choice(["ACTIVE", "BROKEN", "COMPLETE", "COMPLETED"], n)),
-    }
+    })
+
+    # --- >70%-null blocks the cleaner must drop (clean_data.py:31-41) --------
+    # Joint-application, secondary-applicant and hardship-detail blocks, plus
+    # two very sparse month-since columns — all present in the raw table and
+    # all above the 70% null threshold (cell 26 / cell 28).
+    frame.update({
+        "mths_since_last_record": np.where(
+            rng.random(n) < 0.854, np.nan, rng.exponential(75, n).round(0)),
+        "mths_since_last_major_derog": np.where(
+            rng.random(n) < 0.754, np.nan, rng.exponential(44, n).round(0)),
+    })
+    m_joint = rng.random(n) < 0.928
+    frame.update({
+        "annual_inc_joint": _blocked_col(
+            np.clip(_lognormal(rng, 11.6, 0.5, n), 1e4, 3e6).round(0), m_joint),
+        "dti_joint": _blocked_col(
+            np.clip(rng.normal(19, 7, n), 0, 60).round(2), m_joint),
+        "verification_status_joint": np.where(
+            m_joint, None, rng.choice(schema.VERIFICATION_STATUS, n)),
+        "revol_bal_joint": _blocked_col(
+            np.clip(_lognormal(rng, 9.8, 1.0, n), 0, 6e5).round(0),
+            m_joint | (rng.random(n) < 0.06)),
+    })
+    m_sec = rng.random(n) < 0.9326
+    frame.update({
+        "sec_app_fico_range_low": _blocked_col(
+            np.clip(rng.normal(690, 35, n), 630, 845).round(0), m_sec),
+        "sec_app_fico_range_high": _blocked_col(
+            np.clip(rng.normal(694, 35, n), 634, 849).round(0), m_sec),
+        "sec_app_earliest_cr_line": np.where(
+            m_sec, None, _date_str(np.clip(rng.normal(5400, 2400, n), 400, 20000))),
+        "sec_app_inq_last_6mths": _blocked_col(
+            rng.poisson(0.7, n).astype(float), m_sec),
+        "sec_app_mort_acc": _blocked_col(
+            rng.poisson(1.2, n).astype(float), m_sec),
+        "sec_app_open_acc": _blocked_col(
+            rng.poisson(11, n).astype(float), m_sec),
+        "sec_app_revol_util": _blocked_col(
+            np.clip(rng.normal(0.5, 0.25, n), 0, 1.5).round(3),
+            m_sec | (rng.random(n) < 0.02)),
+        "sec_app_open_act_il": _blocked_col(
+            rng.poisson(2.5, n).astype(float), m_sec),
+        "sec_app_num_rev_accts": _blocked_col(
+            rng.poisson(13, n).astype(float), m_sec),
+        "sec_app_chargeoff_within_12_mths": _blocked_col(
+            rng.poisson(0.03, n).astype(float), m_sec),
+        "sec_app_collections_12_mths_ex_med": _blocked_col(
+            rng.poisson(0.04, n).astype(float), m_sec),
+    })
+    m_hard = rng.random(n) < 0.951
+    # The hardship amount columns are present slightly more often than the
+    # rest of the block (93.78% vs 95.1% null, cell 26).
+    m_hard_amt = m_hard & (rng.random(n) < 0.986)
+    frame.update({
+        "hardship_type": np.where(
+            m_hard, None, np.array(["INTEREST ONLY-3 MONTHS DEFERRAL"] * n)),
+        "hardship_reason": np.where(
+            m_hard, None, rng.choice(["NATURAL_DISASTER", "DISABILITY",
+                                      "UNEMPLOYMENT", "INCOME_CURTAILMENT"], n)),
+        "deferral_term": _blocked_col(np.full(n, 3.0), m_hard),
+        "hardship_amount": _blocked_col(
+            (installment * rng.uniform(0.1, 0.9, n)).round(2), m_hard_amt),
+        "hardship_start_date": np.where(
+            m_hard, None, _date_str(rng.integers(100, 1200, n).astype(float))),
+        "hardship_end_date": np.where(
+            m_hard, None, _date_str(rng.integers(10, 1100, n).astype(float))),
+        "payment_plan_start_date": np.where(
+            m_hard, None, _date_str(rng.integers(10, 1200, n).astype(float))),
+        "hardship_length": _blocked_col(np.full(n, 3.0), m_hard),
+        "hardship_dpd": _blocked_col(rng.poisson(12, n).astype(float), m_hard),
+        "hardship_loan_status": np.where(
+            m_hard | (rng.random(n) < 0.003), None,
+            rng.choice(["Late (16-30 days)", "Late (31-120 days)", "Current"], n)),
+        "orig_projected_additional_accrued_interest": _blocked_col(
+            (installment * rng.uniform(0.05, 0.5, n)).round(2),
+            m_hard_amt | (rng.random(n) < 0.002)),
+        "hardship_payoff_balance_amount": _blocked_col(
+            (loan_amnt * rng.uniform(0.2, 1.0, n)).round(2), m_hard_amt),
+        "hardship_last_payment_amount": _blocked_col(
+            (installment * rng.uniform(0.1, 1.2, n)).round(2), m_hard_amt),
+    })
 
     df = pd.DataFrame(frame)
 

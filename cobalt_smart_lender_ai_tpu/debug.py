@@ -113,24 +113,31 @@ def is_transient_compile_error(e: Exception) -> bool:
     msg = str(e)
     if "remote_compile" not in msg:
         return False
-    transient_symptoms = (
+    lower_symptoms = (
         "response body closed",  # the documented mid-read RPC death
         "bytes were read",
         "connection reset",
         "broken pipe",
-        "socket",
         "stream reset",
-        "EOF",
-        "502", "503", "504",  # proxy/tunnel gateway deaths
+    )
+    # Status tokens matched case-SENSITIVELY as gRPC/HTTP emit them —
+    # lower-casing would make the plain word "internal" (common in
+    # deterministic compiler error text) look transient.
+    exact_symptoms = (
         "UNAVAILABLE",
         "DEADLINE_EXCEEDED",
-        # The documented RPC death surfaces as INTERNAL; deterministic
+        "HTTP 502", "HTTP 503", "HTTP 504",  # proxy/tunnel gateway deaths
+        "EOF",
+        # The documented RPC death surfaces as "INTERNAL:"; deterministic
         # compiler failures carry INVALID_ARGUMENT/NOT_FOUND/UNIMPLEMENTED
-        # statuses and verifier text, so INTERNAL-status remote_compile
-        # failures are treated as channel deaths.
-        "INTERNAL",
+        # statuses, so INTERNAL-status remote_compile failures are treated
+        # as channel deaths.
+        "INTERNAL:",
     )
-    return any(s.lower() in msg.lower() for s in transient_symptoms)
+    low = msg.lower()
+    return any(s in low for s in lower_symptoms) or any(
+        s in msg for s in exact_symptoms
+    )
 
 
 def retry_first_dispatch(dispatch, rebuild, *, is_first: bool, attempts: int = 3):

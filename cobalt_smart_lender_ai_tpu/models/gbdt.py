@@ -46,7 +46,7 @@ from cobalt_smart_lender_ai_tpu.ops.binning import (
     transform,
 )
 from cobalt_smart_lender_ai_tpu.ops.histogram import (
-    gradient_histogram,
+    gradient_histogram_channels,
     select_columns,
 )
 
@@ -257,8 +257,15 @@ def fit_binned_resumable(
             n_nodes = 2**level
             offset = n_nodes - 1
             local = node - offset
+            # Histograms ride as THREE (n_nodes, F, B) channel arrays, never
+            # a stacked (n_nodes, F, B, 3): a minor channel axis of 3 (and
+            # the (..., 2) slices downstream) is lane-padded to 128 by TPU
+            # tiling — the round-5 ablation (tools/ablate_d9.py) attributed
+            # ~1 s of the depth-9 bucket's 1.28 s/tree to exactly that
+            # inflation in the cumsum/gain chain, vs 0.24 s/tree for the
+            # histogram passes themselves.
             if level == 0 or not hist_subtract:
-                hist = gradient_histogram(
+                hg, hh, hw = gradient_histogram_channels(
                     bins,
                     local,
                     g,
@@ -267,9 +274,9 @@ def fit_binned_resumable(
                     n_nodes=n_nodes,
                     n_bins=n_bins,
                     row_block=hist_row_block,
-                )  # (n_nodes, F, B, 3)
+                )  # 3 x (n_nodes, F, B)
                 if axis_name is not None:
-                    hist = jax.lax.psum(hist, axis_name)
+                    hg, hh, hw = jax.lax.psum((hg, hh, hw), axis_name)
             else:
                 # Sibling subtraction (the classic histogram-GBDT trick,
                 # XGBoost/LightGBM both use it): build histograms for LEFT
@@ -278,14 +285,12 @@ def fit_binned_resumable(
                 # width — and derive each right child as parent - left. The
                 # (g, h) vectors are per-tree constants, so the saved level-
                 # (l-1) histogram is exactly the parents'. Halves the
-                # dominant node-one-hot contraction at every level; measured
-                # on the depth-9 33-job search bucket this is the difference
-                # between losing and beating the CPU oracle at 130k rows.
+                # dominant node-one-hot contraction at every level.
                 # Cancellation error on near-empty right children lands on
                 # nodes the min_child_weight guard masks anyway.
                 parent_local = local // 2
                 left_m = (local % 2 == 0).astype(jnp.float32)
-                hist_left = gradient_histogram(
+                left = gradient_histogram_channels(
                     bins,
                     parent_local,
                     g * left_m,
@@ -294,28 +299,30 @@ def fit_binned_resumable(
                     n_nodes=n_nodes // 2,
                     n_bins=n_bins,
                     row_block=hist_row_block,
-                )  # (n_nodes/2, F, B, 3)
+                )  # 3 x (n_nodes/2, F, B)
                 if axis_name is not None:
-                    hist_left = jax.lax.psum(hist_left, axis_name)
-                hist_right = prev_hist - hist_left
-                hist = jnp.stack([hist_left, hist_right], axis=1).reshape(
-                    n_nodes, F, n_bins, 3
+                    left = jax.lax.psum(left, axis_name)
+                hg, hh, hw = (
+                    jnp.stack([lc, pc - lc], axis=1).reshape(n_nodes, F, n_bins)
+                    for lc, pc in zip(left, prev_hist)
                 )
-            prev_hist = hist
+            prev_hist = (hg, hh, hw)
             # Node cover is the w channel summed over feature 0's bins —
             # free by-product of the histogram pass (no scatter-add).
-            level_cover = hist[:, 0, :, 2].sum(axis=-1)
+            level_cover = hw[:, 0, :].sum(axis=-1)
             covers = covers.at[offset : offset + n_nodes].set(level_cover)
-            hist = hist[..., :2]
-            miss = hist[:, :, 0, :]  # (n_nodes, F, 2) missing-bucket sums
-            cum = jnp.cumsum(hist[:, :, 1:, :], axis=2)  # (n_nodes, F, B-1, 2)
-            tot = cum[:, :, -1, :] + miss  # node totals, replicated over F
+            miss_g = hg[:, :, 0]  # (n_nodes, F) missing-bucket sums
+            miss_h = hh[:, :, 0]
+            cum_g = jnp.cumsum(hg[:, :, 1:], axis=2)  # (n_nodes, F, B-1)
+            cum_h = jnp.cumsum(hh[:, :, 1:], axis=2)
+            tot_g = cum_g[:, :, -1] + miss_g  # node totals, replicated over F
+            tot_h = cum_h[:, :, -1] + miss_h
             # Candidate thresholds t = 1..B-2 (cum index t-1). The top
             # candidate t = B-2 puts all non-missing left, missing right.
-            GL = cum[..., :-1, 0]
-            HL = cum[..., :-1, 1]
-            Gm, Hm = miss[..., 0][:, :, None], miss[..., 1][:, :, None]
-            Gt, Ht = tot[..., 0][:, :, None], tot[..., 1][:, :, None]
+            GL = cum_g[..., :-1]
+            HL = cum_h[..., :-1]
+            Gm, Hm = miss_g[:, :, None], miss_h[:, :, None]
+            Gt, Ht = tot_g[:, :, None], tot_h[:, :, None]
 
             def masked_gain(GLv, HLv):
                 GRv, HRv = Gt - GLv, Ht - HLv
@@ -348,10 +355,41 @@ def fit_binned_resumable(
                 jnp.where(do_split, best_gain, 0.0)
             )
 
-            b_row = select_columns(
-                bins, feat_lvl[local], exact_max=n_bins
-            ).astype(jnp.int32)
-            go_left = jnp.where(b_row == 0, ml_lvl[local], b_row <= thr_lvl[local])
+            # Routing WITHOUT per-row gathers: TPU has no fast hardware
+            # gather, and the three (rows,)-sized lookups feat_lvl[local] /
+            # thr_lvl[local] / ml_lvl[local] measured ~0.1 s per LEVEL at the
+            # 33-job 130k-row search bucket — the dominant cost of the whole
+            # fit (round-5 scaling probes: cost ~ per-level and jobs-linear,
+            # nearly K-independent). One fused one-hot x table contraction
+            # rides the MXU instead and is BIT-EXACT: each row's one-hot has
+            # a single 1, so every "sum" is one exact 0/1-weighted term
+            # (thresholds <= 254 and bin values <= 255 are exact in bf16).
+            # bf16 holds integers <= 256 exactly; wider binnings (binning.py
+            # emits int32 bins past 256) ride f32 (exact to 2^24), the same
+            # dtype rule select_columns uses.
+            rdt = jnp.bfloat16 if n_bins <= 256 else jnp.float32
+            feat_oh = jax.nn.one_hot(feat_lvl, F, dtype=rdt)  # (K, F)
+            table = jnp.concatenate(
+                [
+                    feat_oh,
+                    thr_lvl[:, None].astype(rdt),
+                    ml_lvl[:, None].astype(rdt),
+                ],
+                axis=1,
+            )  # (K, F + 2)
+            oh_local = jax.nn.one_hot(local, n_nodes, dtype=rdt)
+            routed = jnp.einsum(
+                "nk,kc->nc", oh_local, table,
+                preferred_element_type=jnp.float32,
+            )  # (N, F + 2): [feature mask | threshold | missing-left]
+            fmask_row = routed[:, :F]
+            thr_row = routed[:, F]
+            ml_row = routed[:, F + 1] > 0.5
+            b_row = jnp.einsum(
+                "nf,nf->n", bins.astype(rdt), fmask_row.astype(rdt),
+                preferred_element_type=jnp.float32,
+            )  # = bins[n, feat_lvl[local[n]]], exactly
+            go_left = jnp.where(b_row == 0, ml_row, b_row <= thr_row)
             node = 2 * node + 1 + (1 - go_left.astype(jnp.int32))
 
         leaf_local = node - (2**depth_cap - 1)
@@ -376,7 +414,15 @@ def fit_binned_resumable(
         leaf_val = -sums[:, 0] / (sums[:, 1] + hp.reg_lambda) * hp.learning_rate
         leaf_val = jnp.where(sums[:, 1] > 0, leaf_val, 0.0) * tree_on
         gains = gains * tree_on  # inert trees must not pollute gain importances
-        margin = margin + leaf_val[leaf_local]
+        # Reuse oh_leaf: an exact one-term dot replaces the (rows,)-sized
+        # leaf_val gather (no fast gather on TPU; see the routing note).
+        # HIGHEST precision keeps the f32 leaf values un-demoted, and a
+        # single 1.0 x value product is bit-equal to the gather.
+        margin = margin + jnp.einsum(
+            "nl,l->n", oh_leaf, leaf_val,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
         return margin, (feats, thrs, mls, gains, covers, leaf_val)
 
     margin0 = (

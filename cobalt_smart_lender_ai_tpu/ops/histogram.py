@@ -62,7 +62,7 @@ def _hist_segsum(bins, node_local, g, h, w, n_nodes: int, n_bins: int) -> jax.Ar
     return out.reshape(n_nodes, F, n_bins, 3)
 
 
-def _hist_matmul(
+def _hist_matmul_acc(
     bins, node_local, g, h, w, n_nodes: int, n_bins: int, row_block: int
 ) -> jax.Array:
     N, F = bins.shape
@@ -108,7 +108,14 @@ def _hist_matmul(
         jnp.zeros((F, n_bins, 3 * K), jnp.float32),
         (bins_b, node_b, ghw_b),
     )
-    return acc.reshape(F, n_bins, 3, K).transpose(3, 0, 1, 2)  # (K, F, B, 3)
+    return acc.reshape(F, n_bins, 3, K)
+
+
+def _hist_matmul(
+    bins, node_local, g, h, w, n_nodes: int, n_bins: int, row_block: int
+) -> jax.Array:
+    acc = _hist_matmul_acc(bins, node_local, g, h, w, n_nodes, n_bins, row_block)
+    return acc.transpose(3, 0, 1, 2)  # (K, F, B, 3)
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "impl", "row_block"))
@@ -145,6 +152,143 @@ def gradient_histogram(
 
         return hist_pallas(bins, node_local, g, h, w, n_nodes=n_nodes, n_bins=n_bins)
     raise ValueError(f"unknown histogram impl {impl!r}")
+
+
+def _hist_matmul_jobs(
+    bins, node_J, g_J, h_J, w_J, n_nodes: int, n_bins: int, row_block: int
+) -> jax.Array:
+    """Joint all-jobs histogram: ONE flat ``(F*B, R) x (R, J*3K)`` dot per row
+    block, with the job axis folded into the rhs non-contracting dim.
+
+    Why this exists: under ``vmap`` (the CV x HPO fan-out), the per-job einsum
+    becomes a dot whose rhs has TWO non-contracting dims (jobs x channels).
+    XLA-TPU lowers that as a degenerate-spatial *convolution* (window = jobs,
+    pad = jobs-1) — measured as the unexplained ~1 s/tree of the depth-9
+    search bucket (round-5 ablation: the histogram pass alone is 0.24 s/tree,
+    the full fit 1.28; the optimized HLO shows `convolution(... window={size=
+    1x33 pad=0_0x32_32})` ops in place of the contraction). Folding jobs into
+    a single flat rhs dim leaves a plain 2-D dot the MXU runs at full rate.
+    Returns ``(F, n_bins, J, 3, K)``."""
+    J, N = node_J.shape
+    F = bins.shape[1]
+    K = n_nodes
+    R = min(row_block, N, max(512, (1 << 27) // max(F * n_bins, 1)))
+    n_blocks = -(-N // R)
+    pad = n_blocks * R - N
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        node_J = jnp.pad(node_J, ((0, 0), (0, pad)))
+        g_J, h_J, w_J = (jnp.pad(v, ((0, 0), (0, pad))) for v in (g_J, h_J, w_J))
+    bins_b = bins.reshape(n_blocks, R, F)
+    # Row-major blocking with jobs minor: the per-block rhs is then built
+    # directly in (R, J, 3K) order — no transpose inside the scan step.
+    node_b = node_J.T.reshape(n_blocks, R, J)
+    ghw_b = jnp.stack([g_J, h_J, w_J], axis=-1).transpose(1, 0, 2).reshape(
+        n_blocks, R, J, 3
+    )
+    iota = jnp.arange(n_bins, dtype=jnp.int32)
+
+    def body(acc, xs):
+        bblk, nblk, gblk = xs  # (R, F), (R, J), (R, J, 3)
+        oh_node = jax.nn.one_hot(nblk, K, dtype=jnp.float32)  # (R, J, K)
+        rhs = (oh_node[:, :, None, :] * gblk[:, :, :, None]).reshape(
+            R, J * 3 * K
+        )
+        oh = (
+            bblk.astype(jnp.int32)[:, :, None] == iota
+        ).astype(jnp.bfloat16).reshape(R, F * n_bins)
+        acc = acc + jax.lax.dot_general(
+            oh, rhs.astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, None
+
+    acc, _ = jax.lax.scan(
+        body,
+        jnp.zeros((F * n_bins, J * 3 * K), jnp.float32),
+        (bins_b, node_b, ghw_b),
+    )
+    return acc.reshape(F, n_bins, J, 3, K)
+
+
+def _channels_matmul_vmappable(
+    bins, node_local, g, h, w, *, n_nodes: int, n_bins: int, row_block: int
+):
+    """The TPU matmul channel-split path with a custom batching rule: the
+    unbatched case runs the single-job block scan; a vmapped call (jobs
+    batched, bins shared) runs the joint `_hist_matmul_jobs` dot instead of
+    letting XLA conv-ify the batched contraction."""
+
+    def _single(bins, node_local, g, h, w):
+        acc = _hist_matmul_acc(
+            bins, node_local, g, h, w, n_nodes, n_bins, row_block
+        )  # (F, B, 3, K)
+        return tuple(acc[:, :, c, :].transpose(2, 0, 1) for c in range(3))
+
+    @jax.custom_batching.custom_vmap
+    def f(bins, node_local, g, h, w):
+        return _single(bins, node_local, g, h, w)
+
+    @f.def_vmap
+    def _rule(axis_size, in_batched, bins_b, node_b, g_b, h_b, w_b):
+        bins_bat, node_bat, g_bat, h_bat, w_bat = in_batched
+        if (not bins_bat) and node_bat and g_bat and h_bat and w_bat:
+            acc = _hist_matmul_jobs(
+                bins_b, node_b, g_b, h_b, w_b, n_nodes, n_bins, row_block
+            )  # (F, B, J, 3, K)
+            outs = tuple(
+                acc[:, :, :, c, :].transpose(2, 3, 0, 1) for c in range(3)
+            )  # each (J, K, F, B)
+            return outs, (True, True, True)
+        # Uncommon batching pattern (e.g. per-job bins): plain vmap of the
+        # single-job impl — correct, may conv-ify, not a hot path.
+        outs = jax.vmap(
+            _single,
+            in_axes=tuple(0 if b else None for b in in_batched),
+        )(bins_b, node_b, g_b, h_b, w_b)
+        return outs, (True, True, True)
+
+    return f(bins, node_local, g, h, w)
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "impl", "row_block"))
+def gradient_histogram_channels(
+    bins: jax.Array,
+    node_local: jax.Array,
+    g: jax.Array,
+    h: jax.Array,
+    w: jax.Array,
+    *,
+    n_nodes: int,
+    n_bins: int,
+    impl: str = "auto",
+    row_block: int = 32768,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Channel-split `gradient_histogram`: ``(g, h, w)`` sums as THREE
+    ``(n_nodes, F, n_bins)`` arrays instead of one ``(n_nodes, F, n_bins, 3)``.
+
+    Same sums, different layout — and on TPU the layout is the whole point:
+    a trailing channel axis of 3 is lane-padded to 128 (T(8,128) tiling, 42x
+    memory/compute inflation), and every consumer slicing ``[..., :2]`` drags
+    that padding through the cumsum/gain chain. The round-5 depth-9 ablation
+    (tools/ablate_d9.py) measured the histogram passes at 0.24 s/tree of a
+    1.28 s/tree fit — the other ~1 s was consumers operating on
+    minor-dim-2/3 arrays. The split form keeps BINS on the lane axis
+    (255 -> 256) everywhere."""
+    if impl == "auto":
+        impl = "segsum" if jax.default_backend() == "cpu" else "matmul"
+    if impl == "matmul":
+        # custom_vmap wrapper: a vmapped call (the CV x HPO fan-out) runs ONE
+        # joint flat dot over all jobs instead of the conv XLA would emit.
+        return _channels_matmul_vmappable(
+            bins, node_local, g, h, w,
+            n_nodes=n_nodes, n_bins=n_bins, row_block=row_block,
+        )
+    stacked = gradient_histogram(
+        bins, node_local, g, h, w,
+        n_nodes=n_nodes, n_bins=n_bins, impl=impl, row_block=row_block,
+    )
+    return tuple(stacked[..., c] for c in range(3))
 
 
 def select_columns(M: jax.Array, idx: jax.Array, *, exact_max: int) -> jax.Array:

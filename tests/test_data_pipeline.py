@@ -131,6 +131,129 @@ def test_drop_training_leakage(engineered):
     assert ff.X.shape[1] == len(ff.feature_names)
 
 
+# The reference's raw table after dropping the two index-artifact columns:
+# 141 columns, transcribed from /root/reference/notebooks/01_data_cleaning.ipynb
+# cell 26 (`df_dropped.isnull().sum()` lists every column).
+REFERENCE_RAW_COLUMNS = (
+    "id loan_amnt funded_amnt funded_amnt_inv term int_rate installment "
+    "grade sub_grade emp_title emp_length home_ownership annual_inc "
+    "verification_status issue_d loan_status pymnt_plan url purpose title "
+    "zip_code addr_state dti delinq_2yrs earliest_cr_line fico_range_low "
+    "fico_range_high inq_last_6mths mths_since_last_delinq "
+    "mths_since_last_record open_acc pub_rec revol_bal revol_util total_acc "
+    "initial_list_status out_prncp out_prncp_inv total_pymnt total_pymnt_inv "
+    "total_rec_prncp total_rec_int total_rec_late_fee recoveries "
+    "collection_recovery_fee last_pymnt_d last_pymnt_amnt next_pymnt_d "
+    "last_credit_pull_d last_fico_range_high last_fico_range_low "
+    "collections_12_mths_ex_med mths_since_last_major_derog policy_code "
+    "application_type annual_inc_joint dti_joint verification_status_joint "
+    "acc_now_delinq tot_coll_amt tot_cur_bal open_acc_6m open_act_il "
+    "open_il_12m open_il_24m mths_since_rcnt_il total_bal_il il_util "
+    "open_rv_12m open_rv_24m max_bal_bc all_util total_rev_hi_lim inq_fi "
+    "total_cu_tl inq_last_12m acc_open_past_24mths avg_cur_bal "
+    "bc_open_to_buy bc_util chargeoff_within_12_mths delinq_amnt "
+    "mo_sin_old_il_acct mo_sin_old_rev_tl_op mo_sin_rcnt_rev_tl_op "
+    "mo_sin_rcnt_tl mort_acc mths_since_recent_bc mths_since_recent_bc_dlq "
+    "mths_since_recent_inq mths_since_recent_revol_delinq "
+    "num_accts_ever_120_pd num_actv_bc_tl num_actv_rev_tl num_bc_sats "
+    "num_bc_tl num_il_tl num_op_rev_tl num_rev_accts num_rev_tl_bal_gt_0 "
+    "num_sats num_tl_120dpd_2m num_tl_30dpd num_tl_90g_dpd_24m "
+    "num_tl_op_past_12m pct_tl_nvr_dlq percent_bc_gt_75 "
+    "pub_rec_bankruptcies tax_liens tot_hi_cred_lim total_bal_ex_mort "
+    "total_bc_limit total_il_high_credit_limit revol_bal_joint "
+    "sec_app_fico_range_low sec_app_fico_range_high "
+    "sec_app_earliest_cr_line sec_app_inq_last_6mths sec_app_mort_acc "
+    "sec_app_open_acc sec_app_revol_util sec_app_open_act_il "
+    "sec_app_num_rev_accts sec_app_chargeoff_within_12_mths "
+    "sec_app_collections_12_mths_ex_med hardship_flag hardship_type "
+    "hardship_reason hardship_status deferral_term hardship_amount "
+    "hardship_start_date hardship_end_date payment_plan_start_date "
+    "hardship_length hardship_dpd hardship_loan_status "
+    "orig_projected_additional_accrued_interest "
+    "hardship_payoff_balance_amount hardship_last_payment_amount "
+    "debt_settlement_flag"
+).split()
+
+
+def test_reference_schema_census():
+    """Pin the pipeline's observable column census to the reference's.
+
+    Raw: the full-schema synthetic frame must cover the reference's 141 raw
+    columns exactly (01_data_cleaning.ipynb cell 26). Downstream widths are
+    pinned with an exact reconciliation to the reference notebook's counts
+    (03_feature_engineering.ipynb cells 3/23): the notebook keeps
+    `last_credit_pull_d` and `mths_since_recent_revol_delinq`, which
+    src/clean_data.py:133 (our contract, schema.CLEAN_UNNECESSARY_COLS)
+    drops — so cleaned = 106 - 2 = 104 and the NN frame = 116 - 3 = 113
+    (those two columns plus mths_since_recent_revol_delinq_NA). A silent
+    drift in data/schema.py now fails here instead of passing the suite.
+    """
+    import jax
+
+    from cobalt_smart_lender_ai_tpu.data.synthetic import (
+        synthetic_lendingclub_frame,
+    )
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        raw = synthetic_lendingclub_frame(n_rows=8000, seed=11)
+        assert set(REFERENCE_RAW_COLUMNS) <= set(raw.columns), (
+            sorted(set(REFERENCE_RAW_COLUMNS) - set(raw.columns))
+        )
+        # Only declared synthetics beyond the reference set: index artifacts
+        # (dropped by UNNAMED_COLS) and the junk_sparse drop-rule probes.
+        extras = set(raw.columns) - set(REFERENCE_RAW_COLUMNS)
+        assert all(
+            c.startswith(("Unnamed", "junk_sparse")) for c in extras
+        ), sorted(extras)
+
+        cleaned, _ = clean_raw_frame(raw)
+        assert cleaned.shape[1] == 104  # reference notebook: 106 (see above)
+        prepared = prepare_cleaned_frame(cleaned)
+        # Row-null allowance drops the bureau-block rows, like the
+        # reference's 99,995 -> 97,557 (~2.4%).
+        frac_dropped = 1 - len(prepared) / len(raw)
+        assert 0.01 < frac_dropped < 0.06, frac_dropped
+        tree_ff, nn_ff, _ = engineer_features(prepared)
+        assert len(tree_ff.feature_names) == 114
+        assert len(nn_ff.feature_names) == 113  # reference: 116 (see above)
+        ff = drop_training_leakage(tree_ff)
+        assert len(ff.feature_names) == 104
+
+        # Exact one-hot name set (get_dummies drop_first over the observed
+        # vocabularies) and the 20-feature serving contract.
+        onehots = {
+            n for n in tree_ff.feature_names
+            if any(n.startswith(p + "_") for p in schema.ONE_HOT_COLS)
+        }
+        assert len(onehots) == 31
+        for want in (
+            "grade_E", "home_ownership_MORTGAGE",
+            "verification_status_Verified", "application_type_Joint App",
+            "hardship_status_BROKEN", "hardship_status_COMPLETE",
+            "hardship_status_COMPLETED", "hardship_status_No Hardship",
+        ):
+            assert want in onehots, want
+        assert "grade_A" not in onehots  # drop_first
+        for c in schema.SERVING_FEATURES:
+            assert c in ff.feature_names, c
+
+        # The imputation indicators the reference records in cell 18, minus
+        # the notebook-only mths_since_recent_revol_delinq_NA.
+        na_cols = {n for n in nn_ff.feature_names if n.endswith("_NA")}
+        for want in (
+            "emp_length_num_NA", "revol_util_NA", "open_act_il_NA",
+            "open_il_12m_NA", "open_il_24m_NA", "mths_since_rcnt_il_NA",
+            "total_bal_il_NA", "open_rv_12m_NA", "open_rv_24m_NA",
+            "max_bal_bc_NA", "inq_fi_NA", "total_cu_tl_NA",
+            "avg_cur_bal_NA", "bc_open_to_buy_NA", "bc_util_NA",
+            "mo_sin_old_il_acct_NA", "mths_since_recent_bc_NA",
+            "mths_since_recent_inq_NA", "num_tl_120dpd_2m_NA",
+            "pct_tl_nvr_dlq_NA", "percent_bc_gt_75_NA", "dti_NA",
+        ):
+            assert want in na_cols, want
+        assert "no_income" in nn_ff.feature_names
+
+
 def test_split_deterministic_and_sized():
     m1 = np.asarray(split_mask(10_000, 0.2, 22))
     m2 = np.asarray(split_mask(10_000, 0.2, 22))

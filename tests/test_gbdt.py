@@ -168,3 +168,33 @@ def test_chunked_classifier_fit_is_identical():
     np.testing.assert_array_equal(
         np.asarray(a.forest.feature), np.asarray(b.forest.feature)
     )
+
+
+def test_wide_binning_routing_is_exact():
+    """n_bins > 256 (binning.py emits int32 bins there) must route rows
+    exactly: the fit's carried margin and a fresh `predict_margin` re-route
+    of the same forest are bitwise equal — bf16 would round integer bin
+    values above 256 and silently misroute (the routing dtype rule)."""
+    from cobalt_smart_lender_ai_tpu.models.gbdt import fit_binned_resumable
+
+    rng = np.random.default_rng(3)
+    N, F, n_bins = 3000, 6, 300
+    X = jnp.asarray(rng.normal(size=(N, F)).astype(np.float32))
+    y = jnp.asarray((np.asarray(X[:, 0]) > 0.2).astype(np.int32))
+    spec = compute_bin_edges(X, n_bins=n_bins)
+    bins = transform(spec, X)
+    assert int(jnp.max(bins)) > 256  # the regime under test
+    hp = GBDTHyperparams.from_config(
+        __import__(
+            "cobalt_smart_lender_ai_tpu.config", fromlist=["GBDTConfig"]
+        ).GBDTConfig(n_estimators=12, max_depth=5, n_bins=n_bins)
+    )
+    forest, margin_fit = fit_binned_resumable(
+        bins, y, jnp.ones((N,)), jnp.ones((F,), bool), hp,
+        jax.random.PRNGKey(0),
+        n_trees_cap=12, depth_cap=5, n_bins=n_bins,
+    )
+    margin_pred = predict_margin(forest, bins, use_binned=True)
+    np.testing.assert_array_equal(
+        np.asarray(margin_fit), np.asarray(margin_pred)
+    )

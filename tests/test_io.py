@@ -113,6 +113,13 @@ def test_gbdt_artifact_fresh_process_bitwise(tmp_path, trained_gbdt):
         "print('FRESH_PROCESS_OK')\n"
     )
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # Hermetic fresh process: drop any tunneled-accelerator sitecustomize
+    # from PYTHONPATH (it dials its backend at interpreter start; a wedged
+    # tunnel then hangs this CPU-only restore check indefinitely).
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    )
     out = subprocess.run(
         [sys.executable, "-c", script],
         capture_output=True,

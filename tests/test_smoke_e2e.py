@@ -49,9 +49,14 @@ def smoke_env(tmp_path_factory, engineered):
 
     # 10-row labeled sample, balanced like a smoke operator would pick
     # (automation_test.py samples 10 rows and prints the labels).
+    # Like the reference's operator, pick scoreable borrowers: rows with a
+    # complete 20-field payload (the CSV wire format can carry NaN, but the
+    # smoke flow mirrors automation_test.py's fully-populated records; the
+    # full-schema synthetic frame block-masks some serving features).
     Xte, yte = np.asarray(X_test), np.asarray(y_test)
-    pos = np.flatnonzero(yte == 1)[:5]
-    neg = np.flatnonzero(yte == 0)[:5]
+    full = ~np.isnan(Xte.astype(np.float64)).any(axis=1)
+    pos = np.flatnonzero((yte == 1) & full)[:5]
+    neg = np.flatnonzero((yte == 0) & full)[:5]
     idx = np.concatenate([pos, neg])
     sample = pd.DataFrame(Xte[idx], columns=list(schema.SERVING_FEATURES))
     labels = yte[idx]

@@ -153,12 +153,22 @@ def test_single_prediction_mode_renders(monkeypatch, live_server):
     assert len(labels) == 11  # 12 numeric inputs minus the term selectbox
 
 
+def _complete_rows(X, k: int) -> np.ndarray:
+    """First ``k`` NaN-free rows: the explorer rebuilds a /predict JSON body,
+    whose contract (all 20 fields required and typed, like the reference's
+    pydantic schema) cannot express a missing value — the full-schema
+    synthetic frame now carries block-missing serving features by design."""
+    Xn = np.asarray(X, dtype=np.float64)
+    full = ~np.isnan(Xn).any(axis=1)
+    return Xn[np.flatnonzero(full)[:k]]
+
+
 def test_bulk_mode_renders_table_importance_and_row_explorer(
     monkeypatch, live_server
 ):
     url, X = live_server
     df = pd.DataFrame(
-        np.asarray(X[:6], dtype=np.float64),
+        _complete_rows(X, 6),
         columns=list(schema.SERVING_FEATURES),
     )
     script = {
@@ -200,8 +210,9 @@ def test_bulk_results_invalidate_on_new_upload_and_importance_is_cached(
 
     url, X = live_server
     cols = list(schema.SERVING_FEATURES)
-    df_a = pd.DataFrame(np.asarray(X[:4], dtype=np.float64), columns=cols)
-    df_b = pd.DataFrame(np.asarray(X[4:10], dtype=np.float64), columns=cols)
+    rows = _complete_rows(X, 10)
+    df_a = pd.DataFrame(rows[:4], columns=cols)
+    df_b = pd.DataFrame(rows[4:10], columns=cols)
 
     counts = {"importance": 0}
     orig = core.ApiClient.feature_importance_bulk

@@ -37,7 +37,6 @@ import argparse
 import json
 import sys
 import time
-from functools import partial
 from pathlib import Path
 
 import numpy as np
@@ -252,25 +251,28 @@ def main() -> None:
 
         run_levels("onehot", onehot_level)
 
-    # ---- rhs: build the node-one-hot rhs, contract to width 1 --------------
+    # ---- rhs: build the node-one-hot rhs PER BLOCK, contract to width 1 ----
     if want is None or "rhs" in want:
-        ones_fb = jnp.ones((ROW_BLOCK, 1), jnp.bfloat16)
+        ones_1 = jnp.ones((ROW_BLOCK, 1), jnp.float32)
+        nodes_b = [_blocked(nd) for nd in nodes]  # (nb, R) per level
 
         def rhs_level(s, lvl, ghw_j):
             K = WIDTHS[lvl]
-            oh_node = jax.nn.one_hot(nodes[lvl], K, dtype=jnp.float32)
-            rhs = (oh_node[:, None, :] * ghw_j.T[:, :, None]).reshape(N, 3 * K)
-            rhs = rhs * (1.0 + 1e-12 * s)
-            rhs_b = _blocked(rhs)
+            ghw_b = _blocked(ghw_j.T * (1.0 + 1e-12 * s))  # (nb, R, 3)
 
-            def body(acc, r_blk):
+            def body(acc, xs):
+                nblk, gblk = xs
+                oh_node = jax.nn.one_hot(nblk, K, dtype=jnp.float32)
+                rhs = (oh_node[:, None, :] * gblk[:, :, None]).reshape(
+                    ROW_BLOCK, 3 * K
+                )
                 return acc + jnp.einsum(
-                    "rk,rc->kc", r_blk, ones_fb.astype(jnp.float32),
+                    "rk,rc->kc", rhs, ones_1,
                     preferred_element_type=jnp.float32,
                 ), None
 
             acc, _ = jax.lax.scan(
-                body, jnp.zeros((3 * K, 1), jnp.float32), rhs_b
+                body, jnp.zeros((3 * K, 1), jnp.float32), (nodes_b[lvl], ghw_b)
             )
             return s + acc.sum()
 
