@@ -521,9 +521,13 @@ def fit_binned_chunked(
         )
     from cobalt_smart_lender_ai_tpu.debug import retry_first_dispatch
 
+    from cobalt_smart_lender_ai_tpu.parallel.budget import SteadyLoopTimer
+
     N = bins.shape[0]
+    F = bins.shape[1]
     margin = jnp.zeros((N,), jnp.float32)
     chunks = []
+    timer = SteadyLoopTimer(-(-n_trees_cap // chunk_trees))
     for off in range(0, n_trees_cap, chunk_trees):
         def _dispatch():
             return fit_binned_resumable(
@@ -548,7 +552,20 @@ def fit_binned_chunked(
         forest_c, margin = retry_first_dispatch(
             _dispatch, _rebuild, is_first=off == 0
         )
+        if off == 0:
+            # Post-compile steady timer for the persistent chunk calibration
+            # (parallel/budget.py SteadyLoopTimer).
+            timer.first_done(lambda: np.asarray(margin[:1]))
         chunks.append(forest_c)
+    timer.finish(
+        lambda: np.asarray(margin[:1]),
+        units_per_dispatch=chunk_trees,
+        n_rows=N,
+        n_feats=F,
+        n_bins=n_bins,
+        depth=depth_cap,
+        hist_subtract=hist_subtract,
+    )
     return concat_forest_chunks(chunks, n_trees_cap, depth_cap)
 
 

@@ -88,6 +88,169 @@ COMPILE_RISK_CELLS = 50_000_000
 AUTO = "auto"
 
 
+#: Bounded correction of the cost model from *measured* walls: the model is
+#: deliberately ~2x conservative (see A_LEVEL), and that tax was paid on
+#: every chunked dispatch forever. Chunk sizes cannot adapt mid-loop on this
+#: backend (every distinct chunk size is a fresh 40-400s remote compile), so
+#: the loop ratchets ACROSS runs instead: each chunked loop records its
+#: realized s/tree per workload-shape bucket (one end-of-loop sync, no
+#: per-dispatch host round-trips), and `resolve_chunk_trees` scales the
+#: model by the bucket's median measured/model ratio, clamped to this band.
+#: The upper clamp keeps a polluted measurement (host contention) from
+#: shrinking chunks below the model; the lower clamp caps the speed-up at
+#: 2x so one optimistic measurement can never push a dispatch past the
+#: ~60s kill (model x 0.5 x chunk <= budget x 2 < kill).
+CALIBRATION_CLAMP = (0.5, 2.0)
+
+_CALIBRATION_PATH = None  # resolved lazily; module-level for test override
+
+
+def _calibration_path():
+    import os
+
+    global _CALIBRATION_PATH
+    if _CALIBRATION_PATH is None:
+        _CALIBRATION_PATH = os.path.join(
+            os.path.expanduser("~/.cache/cobalt_smart_lender_ai_tpu"),
+            "dispatch_walls.json",
+        )
+    return _CALIBRATION_PATH
+
+
+def _shape_key(n_rows: int, n_feats: int, n_bins: int, depth: int, n_jobs: int) -> str:
+    """Bucketed workload-shape key: rows by power of two, the rest exact —
+    coarse enough that reruns of the same protocol stage hit it, fine enough
+    that a 130k measurement never calibrates a 2.3M dispatch."""
+    import math
+
+    rows_b = 1 << max(0, int(math.log2(max(n_rows, 1))))
+    return f"r{rows_b}_f{n_feats}_b{n_bins}_d{depth}_j{n_jobs}"
+
+
+def record_dispatch_walls(
+    *,
+    n_rows: int,
+    n_feats: int,
+    n_bins: int,
+    depth: int,
+    n_jobs: int,
+    n_trees: int,
+    wall_s: float,
+    hist_subtract: bool = False,
+) -> None:
+    """Append a measured loop wall (as s/tree) for this workload shape.
+    Best-effort: an unwritable cache dir or a concurrent-writer race loses a
+    sample, never raises into the training loop."""
+    import json
+    import logging
+    import os
+
+    t_model = est_tree_seconds(
+        n_rows, n_feats, n_bins, depth, n_jobs, hist_subtract=hist_subtract
+    )
+    measured = wall_s / max(n_trees, 1)
+    ratio = measured / max(t_model, 1e-12)
+    key = _shape_key(n_rows, n_feats, n_bins, depth, n_jobs)
+    lo, hi = CALIBRATION_CLAMP
+    logging.getLogger(__name__).info(
+        "dispatch calibration %s: measured %.3f s/tree, model %.3f "
+        "(measured/model %.2f; factor applied to future chunks clamps to "
+        "[%.1f, %.1f])",
+        key, measured, t_model, ratio, lo, hi,
+    )
+    path = _calibration_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        data = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+        samples = data.get(key, [])
+        samples.append(round(measured / max(t_model, 1e-12), 4))
+        data[key] = samples[-16:]  # keep a short recent window
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+    except (OSError, ValueError) as e:
+        logging.getLogger(__name__).debug("calibration store skipped: %s", e)
+
+
+class SteadyLoopTimer:
+    """One shared timing protocol for every chunked dispatch loop.
+
+    Measures the loop's POST-COMPILE steady wall — ``first_done(sync)`` after
+    the first dispatch completes (the sync fetches one scalar, bounding the
+    async queue and excluding the remote-compile wall), ``finish(sync, ...)``
+    after the last dispatch has been drained — and records s/tree for the
+    shape bucket via `record_dispatch_walls`. The denominator counts the
+    dispatches actually EXECUTED after the first at their full chunk size
+    (a ragged tail still runs the full-size program with inert tree slots),
+    so the measurement reflects executed compute, not logical trees.
+    Disabled below ``min_dispatches`` (too little signal past the compile).
+    """
+
+    def __init__(self, n_dispatches: int, min_dispatches: int = 3):
+        self.n_dispatches = n_dispatches
+        self._enabled = n_dispatches >= min_dispatches
+        self._t0 = None
+
+    def first_done(self, sync) -> None:
+        if self._enabled and self._t0 is None:
+            import time
+
+            sync()
+            self._t0 = time.time()
+
+    def finish(
+        self,
+        sync,
+        *,
+        units_per_dispatch: int,
+        n_rows: int,
+        n_feats: int,
+        n_bins: int,
+        depth: int,
+        n_jobs: int = 1,
+        hist_subtract: bool = False,
+    ) -> None:
+        if self._t0 is None:
+            return
+        import time
+
+        sync()
+        record_dispatch_walls(
+            n_rows=n_rows,
+            n_feats=n_feats,
+            n_bins=n_bins,
+            depth=depth,
+            n_jobs=n_jobs,
+            n_trees=(self.n_dispatches - 1) * units_per_dispatch,
+            wall_s=time.time() - self._t0,
+            hist_subtract=hist_subtract,
+        )
+
+
+def calibration_factor(
+    n_rows: int, n_feats: int, n_bins: int, depth: int, n_jobs: int
+) -> float:
+    """Median measured/model ratio for this shape bucket, clamped to
+    CALIBRATION_CLAMP; 1.0 when no measurements exist."""
+    import json
+    import statistics
+
+    try:
+        with open(_calibration_path()) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return 1.0
+    samples = data.get(_shape_key(n_rows, n_feats, n_bins, depth, n_jobs))
+    if not samples:
+        return 1.0
+    lo, hi = CALIBRATION_CLAMP
+    return min(max(statistics.median(samples), lo), hi)
+
+
 def est_tree_seconds(
     n_rows: int,
     n_feats: int,
@@ -128,7 +291,7 @@ def auto_chunk_trees(
     when the whole fit fits one dispatch (no chunking machinery needed)."""
     t_tree = est_tree_seconds(
         n_rows, n_feats, n_bins, depth, n_jobs, hist_subtract=hist_subtract
-    )
+    ) * calibration_factor(n_rows, n_feats, n_bins, depth, n_jobs)
     if t_tree * n_trees <= budget_s:
         return None
     return max(1, int(budget_s / max(t_tree, 1e-12)))
@@ -187,8 +350,11 @@ def auto_steps_per_dispatch(
 __all__ = [
     "AUTO",
     "DISPATCH_BUDGET_S",
+    "CALIBRATION_CLAMP",
     "est_tree_seconds",
     "auto_chunk_trees",
     "resolve_chunk_trees",
     "auto_steps_per_dispatch",
+    "record_dispatch_walls",
+    "calibration_factor",
 ]

@@ -214,8 +214,11 @@ def _eliminate_on_device(
 
     from cobalt_smart_lender_ai_tpu.debug import retry_first_dispatch
 
+    from cobalt_smart_lender_ai_tpu.parallel.budget import SteadyLoopTimer
+
     mask, ranking, next_rank = _initial_carry()
     history = []
+    timer = SteadyLoopTimer(-(-n_iters // steps_per_dispatch))
     for it0 in range(0, n_iters, steps_per_dispatch):
         def _dispatch():
             return runner(*args, mask, ranking, next_rank, jnp.int32(it0), hp, rng)
@@ -229,8 +232,23 @@ def _eliminate_on_device(
         mask, ranking, next_rank, hist = retry_first_dispatch(
             _dispatch, _rebuild, is_first=it0 == 0
         )
+        if it0 == 0:
+            # Post-compile steady timer for the persistent chunk calibration
+            # (parallel/budget.py SteadyLoopTimer).
+            timer.first_done(lambda: np.asarray(next_rank))
         if want_history:
             history.append(np.asarray(hist[: n_iters - it0]))
+    dp_size = 1 if mesh is None else mesh.shape[dp_axis]
+    timer.finish(
+        lambda: np.asarray(next_rank),
+        units_per_dispatch=steps_per_dispatch * cfg.n_estimators,
+        n_rows=-(-bins.shape[0] // dp_size),
+        n_feats=bins.shape[1],
+        n_bins=n_bins,
+        depth=cfg.max_depth,
+        # The effective mode the dispatch actually ran (dp>1 forces direct).
+        hist_subtract=cfg.hist_subtract and dp_size == 1,
+    )
     mask_np = np.asarray(mask)
     ranking_np = np.asarray(ranking, dtype=np.int64)
     hist_np = (
@@ -280,12 +298,16 @@ def rfe_select(
     steps = cfg.steps_per_dispatch
     dp_size = 1 if mesh is None else mesh.shape[dp_axis]
     n_local = -(-N // dp_size)
+    from cobalt_smart_lender_ai_tpu.parallel.budget import calibration_factor
+
     t_fit = (
         est_tree_seconds(
             n_local, F, n_bins, cfg.max_depth,
             hist_subtract=cfg.hist_subtract and dp_size == 1,
         )
         * cfg.n_estimators
+        # Measured-walls correction (bounded) — parallel/budget.py.
+        * calibration_factor(n_local, F, n_bins, cfg.max_depth, 1)
     )
     # Above the compile-risk threshold a whole-fit program's COMPILE (not its
     # runtime) is the hazard — the K-step scan is a strictly larger program

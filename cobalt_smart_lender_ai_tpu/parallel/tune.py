@@ -328,6 +328,9 @@ def cross_validate_gbdt(
     # schedule): a multi-minute silent dispatch loop is undebuggable when a
     # backend RPC wedges — the last line printed brackets the hang.
     log_every = max(1, len(schedule) // 4)
+    from cobalt_smart_lender_ai_tpu.parallel.budget import SteadyLoopTimer
+
+    timer = SteadyLoopTimer(len(schedule))
     for i, (off, _k_trees) in enumerate(schedule):
         # The FIRST dispatch triggers the (remote) compile, whose RPC
         # occasionally dies mid-read on this backend — a documented
@@ -357,6 +360,10 @@ def cross_validate_gbdt(
             margins = jnp.zeros((n_jobs_padded, n_total), jnp.float32)
 
         margins = retry_first_dispatch(_dispatch, _rebuild, is_first=i == 0)
+        if i == 0:
+            # Steady-state timer starts after the compile; its wall feeds
+            # the persistent chunk-size calibration (parallel/budget.py).
+            timer.first_done(lambda: np.asarray(margins[:1, :1]))
         if len(schedule) > 1 and (i + 1) % log_every == 0:
             # Scalar fetch, not block_until_ready (which returns immediately
             # over this tunnel): forces execution up to here, bounding the
@@ -375,6 +382,18 @@ def cross_validate_gbdt(
 
         return jax.vmap(one)(margins, job_fold)
 
+    # Timer stops BEFORE _score (a separate program whose first compile
+    # would otherwise pollute the measurement).
+    timer.finish(
+        lambda: np.asarray(margins[:1, :1]),
+        units_per_dispatch=schedule[0][1],
+        n_rows=-(-N // dp_size),
+        n_feats=F,
+        n_bins=n_bins,
+        depth=depth_cap,
+        n_jobs=n_jobs_padded // hp_size,
+        hist_subtract=hist_subtract,
+    )
     aucs = _score(margins, val_p, w_p, job_fold, y_p.astype(jnp.float32))
     return aucs[:n_jobs].reshape(C, K)
 

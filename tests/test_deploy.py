@@ -7,6 +7,7 @@ must exist in this repo."""
 import pathlib
 import re
 
+import pytest
 import yaml
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
@@ -87,3 +88,38 @@ def test_store_uri_env_reaches_the_serve_cli(monkeypatch, tmp_path):
     with __import__("pytest").raises(SystemExit):
         m.main()
     assert str(tmp_path / "lake") in repr(vars(seen["store"]))
+
+
+@pytest.mark.skipif(
+    not (ROOT / "artifacts" / "models" / "gbdt").exists(),
+    reason="committed artifact not yet trained (tools/train_artifact.py)",
+)
+def test_committed_artifact_serves_out_of_the_box():
+    """The reference ships its trained model in-repo
+    (src/api/models/xgb_model_tree.pkl) so the API container serves without
+    a training run (cobalt_fast_api.py:36-54). Our counterpart: the
+    committed GBDTArtifact at the default ServeConfig location must restore
+    and score a full 20-feature payload in a fresh ScorerService."""
+    import numpy as np
+
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+    from cobalt_smart_lender_ai_tpu.data import schema
+    from cobalt_smart_lender_ai_tpu.io import ObjectStore
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    cfg = ServeConfig()
+    store = ObjectStore(str(ROOT / "artifacts"))
+    service = ScorerService.from_store(store, cfg)
+    assert tuple(service.feature_names) == schema.SERVING_FEATURES
+    row = {name: 1.0 for name in schema.SERVING_FEATURES}
+    row.update({
+        "loan_amnt": 12000.0, "term": 36.0, "installment": 380.0,
+        "fico_range_low": 690.0, "last_fico_range_high": 700.0,
+        "earliest_cr_line_days": 5200.0, "emp_length_num": 6.0,
+    })
+    out = service.predict_single(row)
+    p = out["prob_default"]
+    assert 0.0 <= p <= 1.0 and np.isfinite(p)
+    assert len(out["shap_values"]) == len(schema.SERVING_FEATURES)
+    # provenance rides the artifact
+    assert service.artifact.metrics.get("test_auc", 0) >= 0.9

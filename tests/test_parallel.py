@@ -345,17 +345,24 @@ def test_hist_subtraction_quality_matches_direct(small_binned):
     assert auc_sub > 0.9
 
 
-def test_budget_auto_chunk_derivation():
+def test_budget_auto_chunk_derivation(tmp_path, monkeypatch):
     """The dispatch-budget model must reproduce the calibration points' safe
     chunk sizes: whole fits for tiny work, the measured-safe 1-2 rounds at
     the full-table depth-9 bucket, and — under the deliberately conservative
     A_LEVEL — a 130k-row depth-9 chunk safely below the crashed 50 while
-    keeping the estimated dispatch inside the budget."""
+    keeping the estimated dispatch inside the budget. (Empty calibration
+    store pinned: the assertions are about the MODEL, and this machine's
+    real store may hold measured ratios for these exact shape buckets.)"""
+    from cobalt_smart_lender_ai_tpu.parallel import budget
     from cobalt_smart_lender_ai_tpu.parallel.budget import (
         DISPATCH_BUDGET_S,
         auto_chunk_trees,
         est_tree_seconds,
         resolve_chunk_trees,
+    )
+
+    monkeypatch.setattr(
+        budget, "_CALIBRATION_PATH", str(tmp_path / "empty.json")
     )
 
     assert (
@@ -379,6 +386,48 @@ def test_budget_auto_chunk_derivation():
     assert resolve_chunk_trees(7, **shape) == 7
     assert resolve_chunk_trees(None, **shape) is None
     assert resolve_chunk_trees("auto", **shape) is None  # tiny => one dispatch
+
+
+def test_dispatch_wall_calibration_store(tmp_path, monkeypatch):
+    """Measured walls feed back into chunk derivation: a shape bucket whose
+    dispatches measured ~half the model's estimate doubles the auto chunk
+    (clamped to CALIBRATION_CLAMP so one sample can never push a dispatch
+    past the kill threshold), and an unwritable store degrades silently."""
+    from cobalt_smart_lender_ai_tpu.parallel import budget
+
+    monkeypatch.setattr(
+        budget, "_CALIBRATION_PATH", str(tmp_path / "walls.json")
+    )
+    shape = dict(n_rows=130_000, n_feats=20, n_bins=255, depth=9, n_jobs=33)
+    base = budget.auto_chunk_trees(300, **shape)
+    assert budget.calibration_factor(**shape) == 1.0  # no samples yet
+
+    t_model = budget.est_tree_seconds(**shape)
+    # Three runs measured at half the model's s/tree.
+    for _ in range(3):
+        budget.record_dispatch_walls(
+            **shape, n_trees=10, wall_s=10 * t_model * 0.5
+        )
+    assert abs(budget.calibration_factor(**shape) - 0.5) < 0.05
+    assert budget.auto_chunk_trees(300, **shape) >= int(1.9 * base)
+
+    # Clamp: an absurdly fast measurement cannot push beyond the band.
+    for _ in range(8):
+        budget.record_dispatch_walls(
+            **shape, n_trees=10, wall_s=10 * t_model * 0.01
+        )
+    assert budget.calibration_factor(**shape) == budget.CALIBRATION_CLAMP[0]
+
+    # A different shape bucket is untouched.
+    other = dict(shape, depth=5)
+    assert budget.calibration_factor(**other) == 1.0
+
+    # Unwritable store: best-effort no-op, never raises.
+    monkeypatch.setattr(
+        budget, "_CALIBRATION_PATH", "/proc/definitely/not/writable.json"
+    )
+    budget.record_dispatch_walls(**shape, n_trees=10, wall_s=1.0)
+    assert budget.calibration_factor(**shape) == 1.0
 
 
 def test_rfe_device_steps_match_host_loop():

@@ -1,0 +1,131 @@
+"""Oracle wall-vs-rows scale curve -> PARITY_SCALE.json.
+
+VERDICT r4 missing-item #2: "beats the oracle" was proven at 130k rows only,
+while the full-scale claim rested on our 2.3M number alone. A 2.3M CPU-oracle
+run would take ~10h on this 1-core host, so instead the oracle protocol legs
+(tools/parity.py oracle — the sklearn HistGradientBoostingClassifier through
+the reference's RFE + search protocol, model_tree_train_test.py:111-159) are
+measured at several row counts and each leg's wall is fitted with a power law
+
+    wall(N) = c * N^p        (least squares on log-log)
+
+whose extrapolation to the 2.3M protocol scale is committed NEXT TO our
+measured 2.3M wall (BENCH_PROTOCOL.json). The artifact labels the oracle
+number as an extrapolation — the claim it supports is the *scaling shape*
+("the gap grows with N"), anchored by the measured points it interpolates.
+
+Usage:
+    python tools/scale_curve.py PARITY_oracle.json /tmp/PARITY_oracle_260k.json \
+        /tmp/PARITY_oracle_520k.json --target-rows 2300000 \
+        --ours BENCH_PROTOCOL.json --out PARITY_SCALE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+from pathlib import Path
+
+LEGS = ("rfe", "search", "total")
+
+
+def fit_power_law(points: list[tuple[int, float]]) -> tuple[float, float]:
+    """Least-squares fit of log(wall) = log(c) + p*log(N); returns (c, p)."""
+    xs = [math.log(n) for n, _ in points]
+    ys = [math.log(w) for _, w in points]
+    n = len(xs)
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    sxx = sum((x - mx) ** 2 for x in xs)
+    sxy = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+    p = sxy / sxx
+    c = math.exp(my - p * mx)
+    return c, p
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("oracle_jsons", nargs="+")
+    ap.add_argument("--target-rows", type=int, default=2_300_000)
+    ap.add_argument("--ours", default=None,
+                    help="BENCH_PROTOCOL.json with our measured target-scale legs")
+    ap.add_argument("--out", default="PARITY_SCALE.json")
+    args = ap.parse_args(argv)
+
+    runs = []
+    for path in args.oracle_jsons:
+        doc = json.loads(Path(path).read_text())
+        if doc.get("side") != "oracle":
+            raise SystemExit(f"{path} is not an oracle-side parity artifact")
+        runs.append(doc)
+    runs.sort(key=lambda d: d["n_rows"])
+    if len({d["n_rows"] for d in runs}) < 2:
+        raise SystemExit("need oracle runs at >= 2 distinct row counts")
+
+    curves = {}
+    for leg in LEGS:
+        points = [(d["n_rows"], d["seconds"][leg]) for d in runs]
+        c, p = fit_power_law(points)
+        fitted = {
+            str(n): round(c * n**p, 1) for n, _ in points
+        }
+        max_resid = max(
+            abs(c * n**p - w) / w for n, w in points
+        )
+        curves[leg] = {
+            "model": "wall_s = c * rows^p",
+            "c": c,
+            "p": round(p, 4),
+            "measured_points": {str(n): w for n, w in points},
+            "fitted_at_points": fitted,
+            "max_relative_residual": round(max_resid, 4),
+            "extrapolated_wall_s_at_target": round(
+                c * args.target_rows**p, 1
+            ),
+        }
+
+    doc = {
+        "artifact": "oracle wall-vs-rows scale curve (extrapolated target)",
+        "oracle_backend": runs[0]["backend"],
+        "target_rows": args.target_rows,
+        "n_measured_points": len(runs),
+        "note": (
+            "target-row oracle walls are EXTRAPOLATED from the measured "
+            "points via per-leg power-law fits; the measured points "
+            "themselves are real runs of tools/parity.py oracle"
+        ),
+        "curves": curves,
+    }
+    if args.ours:
+        ours = json.loads(Path(args.ours).read_text())
+        stages = ours.get("seconds_stage", {})
+        ours_legs = {
+            "rfe": stages.get("rfe"),
+            "search": stages.get("search"),
+            "total": ours.get("seconds_total"),
+        }
+        doc["ours_measured_at_target"] = {
+            "source": "BENCH_PROTOCOL.json (measured, one chip)",
+            "n_rows": ours.get("n_rows"),
+            "seconds": ours_legs,
+        }
+        doc["speedup_at_target"] = {
+            leg: round(
+                curves[leg]["extrapolated_wall_s_at_target"] / ours_legs[leg], 2
+            )
+            for leg in LEGS
+            if ours_legs.get(leg)
+        }
+    Path(args.out).write_text(json.dumps(doc, indent=2))
+    print(json.dumps({
+        "out": args.out,
+        "exponents": {leg: curves[leg]["p"] for leg in LEGS},
+        "oracle_extrapolated_total_at_target":
+            curves["total"]["extrapolated_wall_s_at_target"],
+        "speedup_at_target": doc.get("speedup_at_target"),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Train and commit the servable model artifact — the reference ships its
+trained model in-repo (`/root/reference/src/api/models/xgb_model_tree.pkl`,
+2.2MB) so `docker-compose up` serves out of the box (cobalt_fast_api.py:36-54);
+this produces our counterpart: a GBDTArtifact npz + `.features.json` sidecar
+at the default ServeConfig store location (`artifacts/models/gbdt/model_tree`),
+trained on the 20 serving-contract features with the protocol's tuned
+hyperparameters.
+
+Usage:
+    python tools/train_artifact.py [--rows 130000] [--out artifacts]
+
+The training frame is the full-schema synthetic generator (the real table is
+behind a private bucket — data/bootstrap.py); the artifact records provenance
+(rows, seed, params, test AUC) in its metrics blob.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=130_000)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--out", default="artifacts")
+    ap.add_argument("--key", default="models/gbdt/model_tree")
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from cobalt_smart_lender_ai_tpu.config import GBDTConfig
+    from cobalt_smart_lender_ai_tpu.data import (
+        clean_raw_frame,
+        engineer_features,
+        prepare_cleaned_frame,
+        synthetic_lendingclub_frame,
+        train_test_split_hashed,
+    )
+    from cobalt_smart_lender_ai_tpu.data import schema
+    from cobalt_smart_lender_ai_tpu.data.features import drop_training_leakage
+    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+    from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+    from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
+    from cobalt_smart_lender_ai_tpu.ops.metrics import roc_auc
+
+    enable_persistent_compile_cache()
+    t0 = time.time()
+    raw = synthetic_lendingclub_frame(n_rows=args.rows, seed=args.seed)
+    cleaned, _ = clean_raw_frame(raw)
+    tree_ff, _, _ = engineer_features(prepare_cleaned_frame(cleaned))
+    ff = drop_training_leakage(tree_ff).select(schema.SERVING_FEATURES)
+    X_train, X_test, y_train, y_test = train_test_split_hashed(ff.X, ff.y)
+    y_np = np.asarray(y_train)
+    spw = (len(y_np) - y_np.sum()) / max(y_np.sum(), 1.0)
+
+    # The protocol's tuned regime (BENCH_PROTOCOL.json best_params family):
+    # deep-ish trees, low LR, full reference bin budget, class-weighted.
+    cfg = GBDTConfig(
+        n_estimators=300,
+        max_depth=7,
+        learning_rate=0.05,
+        subsample=0.8,
+        colsample_bytree=0.8,
+        n_bins=255,
+        scale_pos_weight=float(spw),
+        chunk_trees="auto",
+    )
+    model = GBDTClassifier(cfg)
+    model.fit(np.asarray(X_train), y_np)
+    margin = model.predict_margin(jnp.asarray(X_test))
+    test_auc = float(roc_auc(jnp.asarray(y_test, jnp.float32), margin))
+    wall = time.time() - t0
+
+    store = ObjectStore(args.out)
+    GBDTArtifact(
+        forest=model.forest,
+        bin_spec=model.bin_spec,
+        feature_names=tuple(schema.SERVING_FEATURES),
+        config={
+            k: getattr(cfg, k)
+            for k in (
+                "n_estimators", "max_depth", "learning_rate", "subsample",
+                "colsample_bytree", "n_bins", "scale_pos_weight", "seed",
+            )
+        },
+        metrics={
+            "test_auc": round(test_auc, 4),
+            "train_rows": int(np.asarray(X_train).shape[0]),
+            "data": f"synthetic_lendingclub_frame(rows={args.rows}, seed={args.seed})",
+            "trained_wall_s": round(wall, 1),
+        },
+    ).save(store, args.key)
+    print(json.dumps({
+        "artifact": f"{args.out}/{args.key}",
+        "test_auc": round(test_auc, 4),
+        "wall_s": round(wall, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()
