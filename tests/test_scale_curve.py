@@ -1,0 +1,61 @@
+"""Gate the committed oracle scale-curve artifact (PARITY_SCALE.json).
+
+The artifact's claim discipline: measured points must be real oracle-side
+parity runs committed next to it, the per-leg power-law fits must reproduce
+their own measured points, and the target-scale numbers must be labelled as
+extrapolations and arithmetically consistent with the fit.
+"""
+
+import json
+import math
+import pathlib
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+SCALE = ROOT / "PARITY_SCALE.json"
+
+
+@pytest.fixture(scope="module")
+def doc():
+    if not SCALE.exists():
+        pytest.skip("PARITY_SCALE.json not committed")
+    return json.loads(SCALE.read_text())
+
+
+def test_measured_points_come_from_committed_oracle_runs(doc):
+    committed = {}
+    for p in ROOT.glob("PARITY_oracle_*.json"):
+        run = json.loads(p.read_text())
+        assert run["side"] == "oracle"
+        committed[run["n_rows"]] = run
+    assert len(committed) >= 2
+    for leg, curve in doc["curves"].items():
+        for rows, wall in curve["measured_points"].items():
+            run = committed[int(rows)]
+            assert run["seconds"][leg] == wall, (leg, rows)
+
+
+def test_fit_is_consistent_and_extrapolation_labelled(doc):
+    assert "EXTRAPOLATED" in doc["note"].upper() or "extrapolat" in doc["note"]
+    for leg, curve in doc["curves"].items():
+        c, p = curve["c"], curve["p"]
+        # the fit reproduces its own measured points
+        assert curve["max_relative_residual"] < 0.25, leg
+        for rows, wall in curve["measured_points"].items():
+            fitted = c * int(rows) ** p
+            assert abs(fitted - wall) / wall <= curve["max_relative_residual"] + 1e-6
+        # the target number is the fit evaluated at target_rows
+        want = c * doc["target_rows"] ** p
+        assert math.isclose(
+            curve["extrapolated_wall_s_at_target"], want, rel_tol=0.01
+        ), leg
+
+
+def test_speedups_match_ours_measured(doc):
+    ours = doc.get("ours_measured_at_target")
+    if not ours:
+        pytest.skip("no ours-side comparison embedded")
+    for leg, ratio in doc["speedup_at_target"].items():
+        oracle = doc["curves"][leg]["extrapolated_wall_s_at_target"]
+        assert math.isclose(ratio, oracle / ours["seconds"][leg], rel_tol=0.02)

@@ -1,0 +1,91 @@
+"""Measure real-fit s/tree at several workload shapes (budget recalibration).
+
+Used to (re)fit `parallel/budget.py`'s cost-model constants from measured
+points — round 5 rewired the routing (gather-free) and the fan-out
+contraction, making the round-4 calibration points obsolete. Each probe jits
+the REAL `fit_binned` under the fan-out's vmap at the given shape, warms it,
+and reports best-of-2 s/tree (scalar-fetch timing; block_until_ready lies
+over the tunnel).
+
+Usage: python tools/probe_shapes.py [--probes d9j33,d5j33,d9j8,d7j12]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+PROBES = {
+    # name: (rows, feats, bins, jobs, trees, depth)
+    "d9j33": (130_000, 20, 255, 33, 8, 9),
+    "d5j33": (130_000, 20, 255, 33, 8, 5),
+    "d9j8": (130_000, 20, 255, 8, 8, 9),
+    "d7j12": (130_000, 20, 255, 12, 12, 7),
+    "d3full": (2_300_000, 100, 64, 1, 24, 3),  # the bench.py single-fit shape
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--probes", default="d9j33,d5j33,d9j8")
+    args = ap.parse_args()
+    import jax
+    import jax.numpy as jnp
+
+    from cobalt_smart_lender_ai_tpu.config import GBDTConfig
+    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+    from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTHyperparams, fit_binned
+    from cobalt_smart_lender_ai_tpu.parallel.budget import est_tree_seconds
+
+    enable_persistent_compile_cache()
+    for name in args.probes.split(","):
+        N, F, B, J, T, D = PROBES[name]
+        rng = np.random.default_rng(0)
+        bins = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
+        y = jnp.asarray((rng.random(N) < 0.2).astype(np.int32))
+        sw = jnp.ones((N,), jnp.float32)
+        fm = jnp.ones((F,), bool)
+        hp = GBDTHyperparams.from_config(
+            GBDTConfig(n_estimators=T, max_depth=D, n_bins=B)
+        )
+        hps = jax.tree.map(lambda a: jnp.broadcast_to(a, (J,) + a.shape), hp)
+        keys = jax.random.split(jax.random.PRNGKey(0), J)
+
+        @jax.jit
+        def run(hps, keys):
+            def one(hp_j, key):
+                f = fit_binned(
+                    bins, y, sw, fm, hp_j, key,
+                    n_trees_cap=T, depth_cap=D, n_bins=B,
+                )
+                return f.leaf_value.sum()
+
+            return jax.vmap(one)(hps, keys)
+
+        out = run(hps, keys)
+        float(np.asarray(out)[0])  # warm + force
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.time()
+            out = run(hps, keys)
+            float(np.asarray(out)[0])
+            best = min(best, time.time() - t0)
+        model = est_tree_seconds(N, F, B, D, J, hist_subtract=True)
+        print(json.dumps({
+            "probe": name,
+            "shape": f"{N}x{F}x{B} J={J} T={T} depth={D}",
+            "s_per_tree": round(best / T, 4),
+            "model_s_per_tree": round(model, 4),
+            "measured_over_model": round(best / T / max(model, 1e-12), 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
