@@ -39,6 +39,16 @@ def test_measured_points_come_from_committed_oracle_runs(doc):
 def test_fit_is_consistent_and_extrapolation_labelled(doc):
     assert "EXTRAPOLATED" in doc["note"].upper() or "extrapolat" in doc["note"]
     for leg, curve in doc["curves"].items():
+        walls = list(curve["measured_points"].values())
+        if "band_wall_s" in curve:
+            # flat-band mode: the target wall is the measured maximum — the
+            # conservative-against-us choice — and the rejected power fit
+            # is recorded with its reason.
+            assert curve["band_wall_s"] == [min(walls), max(walls)]
+            assert curve["extrapolated_wall_s_at_target"] == max(walls)
+            rej = curve["power_fit_rejected"]
+            assert rej["p"] < 0.05 or rej["max_relative_residual"] > 0.25
+            continue
         c, p = curve["c"], curve["p"]
         # the fit reproduces its own measured points
         assert curve["max_relative_residual"] < 0.25, leg
