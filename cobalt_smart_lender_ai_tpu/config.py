@@ -199,6 +199,39 @@ class MeshConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ReliabilityConfig:
+    """Failure-handling knobs shared by the pipeline and serving layers
+    (consumed by `reliability/` — the SURVEY's "no checkpoint/resume, no
+    fault tolerance" gap)."""
+
+    #: Retry policy for store I/O (see `reliability.retry.RetryPolicy`).
+    max_attempts: int = 4
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    backoff_multiplier: float = 2.0
+    jitter: float = 0.1
+    deadline_s: float | None = None
+    #: Wrap the pipeline's store in a `ResilientStore` (retry + verified
+    #: reads). Off only for benchmarking the raw backend.
+    wrap_store: bool = True
+    #: Verify content-addressed ``.ptr.json`` pointers on every read that
+    #: has one (a mismatched read is retried, then raised).
+    verify_reads: bool = True
+    #: Write per-stage manifests so a crashed run can ``--resume`` from the
+    #: last good stage.
+    checkpoints: bool = True
+    checkpoint_prefix: str = "checkpoints/"
+    #: Resume from valid stage manifests instead of recomputing (also
+    #: reachable per-run via ``run_pipeline(..., resume=True)`` / the
+    #: ``--resume`` CLI flag).
+    resume: bool = False
+    #: Serving: when the SHAP program fails to compile or execute, keep
+    #: serving probabilities with ``"shap_values": null`` and a ``degraded``
+    #: flag instead of returning HTTP 500.
+    degrade_shap: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Serving contract of `cobalt_fast_api.py` — port, model key, history dir."""
 
@@ -213,6 +246,9 @@ class ServeConfig:
     #: backend). ``precompile_batch_buckets`` are warmed at startup.
     max_batch_rows: int = 4096
     precompile_batch_buckets: tuple[int, ...] = (256,)
+    reliability: ReliabilityConfig = dataclasses.field(
+        default_factory=ReliabilityConfig
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -230,3 +266,6 @@ class PipelineConfig:
     rfe: RFEConfig = dataclasses.field(default_factory=RFEConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    reliability: ReliabilityConfig = dataclasses.field(
+        default_factory=ReliabilityConfig
+    )

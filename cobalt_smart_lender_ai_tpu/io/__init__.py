@@ -18,7 +18,11 @@ from cobalt_smart_lender_ai_tpu.io.registry import (
     DatasetPin,
     DatasetRegistry,
 )
-from cobalt_smart_lender_ai_tpu.io.store import ObjectStore
+from cobalt_smart_lender_ai_tpu.io.store import (
+    PTR_SUFFIX,
+    ObjectStore,
+    StoreKeyError,
+)
 
 __all__ = [
     "FORMAT_VERSION",
@@ -27,6 +31,8 @@ __all__ = [
     "GBDTArtifact",
     "MLPArtifact",
     "ObjectStore",
+    "PTR_SUFFIX",
+    "StoreKeyError",
     "REFERENCE_RAW_PINS",
     "load_metrics",
     "plan_from_json",

@@ -26,11 +26,33 @@ from __future__ import annotations
 import hashlib
 import io as _io
 import json
+import os
+import secrets
 import shutil
 from pathlib import Path
 from typing import Iterator
 
 import pandas as pd
+
+#: Suffix of content-addressed pointer objects (`write_pointer`).
+PTR_SUFFIX = ".ptr.json"
+
+
+class StoreKeyError(ValueError):
+    """The key is malformed or escapes the store's root.
+
+    A dedicated type (still a ValueError for backward compatibility) so
+    callers can branch on bad-key errors without catching every ValueError;
+    both the local and the S3 adapter raise it from the same lexical check.
+    """
+
+
+def _validate_key(key: str) -> str:
+    """Reject keys that are absolute or contain ``..`` path segments —
+    applied by every backend so bad keys fail identically everywhere."""
+    if key.startswith(("/", "\\")) or ".." in key.replace("\\", "/").split("/"):
+        raise StoreKeyError(f"key {key!r} is absolute or escapes the store root")
+    return key
 
 
 class ObjectStore:
@@ -110,15 +132,26 @@ class ObjectStore:
             "md5": hashlib.md5(data).hexdigest(),
             "size": len(data),
         }
-        self.put_json(key + ".ptr.json", ptr)
+        self.put_json(key + PTR_SUFFIX, ptr)
         return ptr
 
     def verify_pointer(self, key: str) -> bool:
-        """True iff ``key``'s content still matches its pinned pointer."""
-        ptr = self.get_json(key + ".ptr.json")
-        data = self.get_bytes(key)
+        """True iff ``key``'s content still matches its pinned pointer.
+
+        Contract: returns ``False`` — never raises — when the pointer
+        object or the key itself is missing, unreadable, or malformed, so
+        callers (checkpoint validation, resilient reads) can branch on the
+        result without wrapping every failure mode."""
+        try:
+            ptr = self.get_json(key + PTR_SUFFIX)
+            data = self.get_bytes(key)
+        except Exception:
+            return False
+        if not isinstance(ptr, dict):
+            return False
         return (
-            hashlib.md5(data).hexdigest() == ptr["md5"] and len(data) == ptr["size"]
+            hashlib.md5(data).hexdigest() == ptr.get("md5")
+            and len(data) == ptr.get("size")
         )
 
 
@@ -131,17 +164,25 @@ class _LocalStore(ObjectStore):
         self.root = Path(root)
 
     def _path(self, key: str) -> Path:
+        _validate_key(key)
         p = (self.root / key).resolve()
         if not p.is_relative_to(self.root.resolve()):
-            raise ValueError(f"key {key!r} escapes store root {self.root}")
+            # lexical check above should have caught it; symlink defense
+            raise StoreKeyError(f"key {key!r} escapes store root {self.root}")
         return p
 
     def put_bytes(self, key: str, data: bytes) -> None:
         p = self._path(key)
         p.parent.mkdir(parents=True, exist_ok=True)
-        tmp = p.with_suffix(p.suffix + ".tmp")
-        tmp.write_bytes(data)
-        tmp.replace(p)  # atomic within one filesystem
+        # Unique temp name per writer: with a shared `<key>.tmp`, two
+        # concurrent writers of the same key could truncate each other's
+        # half-written file mid-rename. The rename itself stays atomic.
+        tmp = p.with_name(f"{p.name}.{os.getpid():x}.{secrets.token_hex(4)}.tmp")
+        try:
+            tmp.write_bytes(data)
+            tmp.replace(p)  # atomic within one filesystem
+        finally:
+            tmp.unlink(missing_ok=True)  # only present if the write failed
 
     def get_bytes(self, key: str) -> bytes:
         return self._path(key).read_bytes()
@@ -189,6 +230,7 @@ class _S3Store(ObjectStore):
         self.client = boto3.client("s3")
 
     def _key(self, key: str) -> str:
+        _validate_key(key)
         return f"{self.prefix}/{key}" if self.prefix else key
 
     def put_bytes(self, key: str, data: bytes) -> None:  # pragma: no cover

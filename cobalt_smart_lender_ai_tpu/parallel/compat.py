@@ -1,0 +1,29 @@
+"""JAX API compatibility shims for the parallel layer.
+
+`shard_map` graduated from `jax.experimental.shard_map` (where its
+replication-check kwarg is ``check_rep``) to top-level `jax.shard_map`
+(where it is ``check_vma``). The mesh code targets the new spelling; this
+module makes it run on both, so the framework works on the image's pinned
+jax as well as current releases.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6: top-level API, check_vma kwarg
+    shard_map = jax.shard_map
+except AttributeError:  # older jax: experimental API, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _experimental_shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
+
+__all__ = ["shard_map"]

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from cobalt_smart_lender_ai_tpu.parallel.compat import shard_map
 from cobalt_smart_lender_ai_tpu.config import GBDTConfig, MeshConfig, RFEConfig
 from cobalt_smart_lender_ai_tpu.models.gbdt import (
     GBDTHyperparams,
@@ -171,7 +172,7 @@ def _eliminate_on_device(
         bins_p, y_p, sw_p, _, _ = _prep_dp_rows(mesh, bins, y, sw, None, dp_axis)
 
         @partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(
                 P(dp_axis, None), P(dp_axis), P(dp_axis),  # bins, y, sw

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from cobalt_smart_lender_ai_tpu.parallel.compat import shard_map
 from cobalt_smart_lender_ai_tpu.models.gbdt import (
     Forest,
     GBDTHyperparams,
@@ -77,7 +78,7 @@ def fit_binned_dp(
     )
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(dp_axis, None), P(dp_axis), P(dp_axis), P(None), P(), P(None)),
         out_specs=P(),
@@ -140,7 +141,7 @@ def fit_binned_dp_chunked(
     )
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(
             P(dp_axis),  # carried margin
@@ -209,7 +210,7 @@ def predict_margin_dp(
     Xp = _pad_to(X, n_total, 0)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), P(dp_axis, None)),
         out_specs=P(dp_axis),

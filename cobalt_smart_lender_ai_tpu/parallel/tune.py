@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from cobalt_smart_lender_ai_tpu.parallel.compat import shard_map
 from cobalt_smart_lender_ai_tpu.config import GBDTConfig, MeshConfig, TuneConfig
 from cobalt_smart_lender_ai_tpu.models.gbdt import (
     GBDTClassifier,
@@ -253,7 +254,7 @@ def cross_validate_gbdt(
     # global tree index via `tree_offset`.
     def make_runner(k_trees: int):
         @partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(
                 P(hp_axis, dp_axis),  # carried margins

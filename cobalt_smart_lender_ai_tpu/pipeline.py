@@ -16,6 +16,16 @@ Stages round-trip through the `ObjectStore` when one is given (the
 reference's S3 glue, SURVEY §1), so each stage's output is inspectable and
 restartable; with no store the pipeline runs purely in memory.
 
+Resilience (`reliability/`): the store is wrapped in a `ResilientStore`
+(bounded retry with backoff on transient faults, content-pointer
+verification on reads), and after each stage a manifest pins the stage's
+outputs (md5+size) and its config fingerprint. A run started with
+``resume=True`` (CLI ``--resume``) skips every leading stage whose manifest
+still validates — a crash mid-RFE or mid-search restarts from the last good
+stage instead of from raw data; a changed config invalidates exactly the
+stages that depend on it. `PipelineResult.stages_run`/``stages_skipped``
+record what actually executed.
+
 Entry point::
 
     python -m cobalt_smart_lender_ai_tpu.pipeline --store artifacts \
@@ -38,14 +48,22 @@ from cobalt_smart_lender_ai_tpu.config import (
     RFEConfig,
     TuneConfig,
 )
+from cobalt_smart_lender_ai_tpu.data import schema
 from cobalt_smart_lender_ai_tpu.data.clean import clean_raw_frame
 from cobalt_smart_lender_ai_tpu.data.features import (
+    FeatureFrame,
     drop_training_leakage,
     engineer_features,
     prepare_cleaned_frame,
 )
 from cobalt_smart_lender_ai_tpu.data.split import train_test_split_hashed
-from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore, save_metrics
+from cobalt_smart_lender_ai_tpu.io import (
+    GBDTArtifact,
+    ObjectStore,
+    plan_from_json,
+    plan_to_json,
+    save_metrics,
+)
 from cobalt_smart_lender_ai_tpu.ops.metrics import (
     binary_classification_report,
     roc_auc,
@@ -53,6 +71,12 @@ from cobalt_smart_lender_ai_tpu.ops.metrics import (
 from cobalt_smart_lender_ai_tpu.parallel.mesh import make_mesh
 from cobalt_smart_lender_ai_tpu.parallel.rfe import rfe_select
 from cobalt_smart_lender_ai_tpu.parallel.tune import SearchResult, randomized_search
+from cobalt_smart_lender_ai_tpu.reliability import (
+    PipelineCheckpoint,
+    ResilientStore,
+    config_fingerprint,
+    policy_from_config,
+)
 
 logger = logging.getLogger("cobalt_smart_lender_ai_tpu.pipeline")
 
@@ -70,6 +94,21 @@ class PipelineResult:
     search: SearchResult
     scale_pos_weight: float
     timings: dict[str, float]
+    #: Stage-execution counters: which stages actually computed this run vs
+    #: were restored from a valid checkpoint manifest (resume path).
+    stages_run: tuple[str, ...] = ()
+    stages_skipped: tuple[str, ...] = ()
+
+
+def _tree_frame_to_feature_frame(df: pd.DataFrame) -> FeatureFrame:
+    """Rebuild the engineered `FeatureFrame` from its persisted CSV (the
+    inverse of `FeatureFrame.to_pandas`) — the resume path's restore of the
+    engineer stage, matching how the reference's training script consumes
+    the feature-engineering script's S3 output."""
+    df = df.copy()
+    y = jax.numpy.asarray(df.pop(schema.LABEL_COL).to_numpy(np.float32))
+    X = jax.numpy.asarray(df.to_numpy(np.float32))
+    return FeatureFrame(tuple(df.columns), X, y)
 
 
 def run_pipeline(
@@ -78,12 +117,19 @@ def run_pipeline(
     store: ObjectStore | None = None,
     mesh=None,
     model_key: str | None = None,
+    resume: bool | None = None,
 ) -> PipelineResult:
     """Run the full production path. ``raw`` takes precedence; otherwise the
     frame is loaded from ``store``'s `raw_key` (the reference loads its input
-    CSV from S3, model_tree_train_test.py:77)."""
+    CSV from S3, model_tree_train_test.py:77). With ``resume=True`` (or
+    ``config.reliability.resume``), stages whose checkpoint manifests still
+    validate are restored from the store instead of recomputed."""
     cfg = config or PipelineConfig()
+    rel = cfg.reliability
+    resume = rel.resume if resume is None else resume
     timings: dict[str, float] = {}
+    stages_run: list[str] = []
+    stages_skipped: list[str] = []
 
     def tick(name: str, t0: float) -> float:
         timings[name] = round(time.time() - t0, 3)
@@ -91,38 +137,106 @@ def run_pipeline(
         logger.info("%s done in %.2fs", name, timings[name])
         return t
 
+    if (
+        store is not None
+        and rel.wrap_store
+        and not isinstance(store, ResilientStore)
+    ):
+        store = ResilientStore(
+            store, policy_from_config(rel), verify_reads=rel.verify_reads
+        )
+    ckpt = (
+        PipelineCheckpoint(store, rel.checkpoint_prefix)
+        if store is not None and rel.checkpoints
+        else None
+    )
+
+    # Per-stage config fingerprints: a stage's manifest is invalidated by a
+    # change to any config slice it depends on, and only by those.
+    fp_clean = config_fingerprint("clean", cfg.data)
+    fp_engineer = config_fingerprint("engineer", cfg.data)
+    fp_rfe = config_fingerprint("rfe", cfg.data, cfg.rfe, cfg.mesh)
+    fp_search = config_fingerprint(
+        "search", cfg.data, cfg.rfe, cfg.gbdt, cfg.tune, cfg.mesh
+    )
+
+    # A stage may be skipped only if every stage upstream of it was skipped:
+    # once something re-runs, downstream inputs can no longer be trusted.
+    can_resume = resume and ckpt is not None
+    skip_clean = can_resume and ckpt.valid("clean", fp_clean)
+    skip_engineer = skip_clean and ckpt.valid("engineer", fp_engineer)
+    skip_rfe = skip_engineer and ckpt.valid("rfe", fp_rfe)
+    skip_search = skip_rfe and ckpt.valid("search", fp_search)
+
     t = time.time()
-    if raw is None:
-        if store is None:
-            raise ValueError("provide a raw frame or an object store")
-        raw = store.load_frame(cfg.data.raw_key)
-    logger.info("raw frame: %d rows x %d cols", len(raw), raw.shape[1])
 
     # --- L1 cleaning (clean_data.py:87-158) ---------------------------------
-    cleaned, report = clean_raw_frame(
-        raw, null_col_threshold=cfg.data.null_col_threshold
-    )
-    logger.info(
-        "cleaned: %d rows, dropped %d null-heavy cols, %d dupes",
-        report.n_rows_out,
-        len(report.dropped_null_columns),
-        report.n_duplicates_removed,
-    )
-    if store is not None and cfg.save_intermediate:
-        store.save_frame(cfg.data.cleaned_key, cleaned)
-    t = tick("clean", t)
-
     # --- L2 features (feature_engineering.py:44-184) ------------------------
-    prepared = prepare_cleaned_frame(
-        cleaned, row_null_allowance=cfg.data.row_null_allowance
-    )
-    tree_ff, nn_ff, plan = engineer_features(prepared)
-    if store is not None and cfg.save_intermediate:
-        store.save_frame(cfg.data.tree_key, tree_ff.to_pandas())
-        store.save_frame(cfg.data.nn_key, nn_ff.to_pandas())
-    t = tick("engineer", t)
+    if skip_engineer:
+        manifest = ckpt.load("engineer")
+        plan = plan_from_json(manifest["extra"]["plan"])
+        tree_ff = _tree_frame_to_feature_frame(store.load_frame(cfg.data.tree_key))
+        stages_skipped += ["clean", "engineer"]
+        logger.info(
+            "resume: restored engineered frame (%d rows x %d features) from %s",
+            tree_ff.n_rows,
+            tree_ff.n_features,
+            cfg.data.tree_key,
+        )
+        t = tick("restore", t)
+    else:
+        if skip_clean:
+            cleaned = store.load_frame(cfg.data.cleaned_key)
+            stages_skipped.append("clean")
+            logger.info("resume: restored cleaned frame from %s", cfg.data.cleaned_key)
+        else:
+            if raw is None:
+                if store is None:
+                    raise ValueError("provide a raw frame or an object store")
+                raw = store.load_frame(cfg.data.raw_key)
+            logger.info("raw frame: %d rows x %d cols", len(raw), raw.shape[1])
+            cleaned, report = clean_raw_frame(
+                raw, null_col_threshold=cfg.data.null_col_threshold
+            )
+            logger.info(
+                "cleaned: %d rows, dropped %d null-heavy cols, %d dupes",
+                report.n_rows_out,
+                len(report.dropped_null_columns),
+                report.n_duplicates_removed,
+            )
+            if store is not None and cfg.save_intermediate:
+                store.save_frame(cfg.data.cleaned_key, cleaned)
+                if ckpt is not None:
+                    ckpt.write(
+                        "clean",
+                        fingerprint=fp_clean,
+                        outputs=[cfg.data.cleaned_key],
+                    )
+            stages_run.append("clean")
+            t = tick("clean", t)
+
+        prepared = prepare_cleaned_frame(
+            cleaned, row_null_allowance=cfg.data.row_null_allowance
+        )
+        tree_ff, nn_ff, plan = engineer_features(prepared)
+        if store is not None and cfg.save_intermediate:
+            store.save_frame(cfg.data.tree_key, tree_ff.to_pandas())
+            store.save_frame(cfg.data.nn_key, nn_ff.to_pandas())
+            if ckpt is not None:
+                # The plan rides in the manifest: it is what the resume path
+                # needs to rebuild the artifact without re-engineering.
+                ckpt.write(
+                    "engineer",
+                    fingerprint=fp_engineer,
+                    outputs=[cfg.data.tree_key, cfg.data.nn_key],
+                    extra={"plan": plan_to_json(plan)},
+                )
+        stages_run.append("engineer")
+        t = tick("engineer", t)
 
     # --- L3 training (model_tree_train_test.py:73-242) ----------------------
+    # The hashed split is stateless and cheap: recomputed every run (resumed
+    # or not) so downstream stages always see identical train/test rows.
     ff = drop_training_leakage(tree_ff)
     X_train, X_test, y_train, y_test = train_test_split_hashed(
         ff.X, ff.y, test_fraction=cfg.data.test_fraction, seed=cfg.data.split_seed
@@ -137,29 +251,84 @@ def run_pipeline(
     )
     mesh = mesh or make_mesh(cfg.mesh)
 
-    rfe_cfg = dataclasses.replace(cfg.rfe, scale_pos_weight=spw)
-    rfe = rfe_select(X_train, y_train, rfe_cfg, mesh=mesh)
-    selected = tuple(
-        n for n, keep in zip(ff.feature_names, rfe.support_) if keep
-    )
-    logger.info("RFE selected %d features: %s", len(selected), selected)
-    t = tick("rfe", t)
+    support = None
+    if skip_rfe:
+        extra = ckpt.load("rfe")["extra"]
+        if extra.get("feature_names") == list(ff.feature_names):
+            support = np.zeros(len(ff.feature_names), dtype=bool)
+            support[np.asarray(extra["support_idx"], dtype=int)] = True
+            selected = tuple(extra["selected"])
+            stages_skipped.append("rfe")
+            logger.info("resume: restored RFE selection (%d features)", len(selected))
+        else:  # engineered columns drifted from under the manifest
+            skip_rfe = skip_search = False
+    if support is None:
+        rfe_cfg = dataclasses.replace(cfg.rfe, scale_pos_weight=spw)
+        rfe = rfe_select(X_train, y_train, rfe_cfg, mesh=mesh)
+        support = np.asarray(rfe.support_)
+        selected = tuple(
+            n for n, keep in zip(ff.feature_names, support) if keep
+        )
+        logger.info("RFE selected %d features: %s", len(selected), selected)
+        if ckpt is not None:
+            ckpt.write(
+                "rfe",
+                fingerprint=fp_rfe,
+                extra={
+                    "support_idx": np.flatnonzero(support).tolist(),
+                    "selected": list(selected),
+                    "feature_names": list(ff.feature_names),
+                    "scale_pos_weight": spw,
+                },
+            )
+        stages_run.append("rfe")
+        t = tick("rfe", t)
 
     # Materialize the selected columns once (the reference trains its final
     # model on the 20-column frame); the search then fans out over the mesh.
     # Column-take stays on device — fetching the full matrices to host costs
     # ~minutes at 2.3M rows over a tunneled TPU.
-    sel_idx = np.flatnonzero(rfe.support_)
+    sel_idx = np.flatnonzero(support)
     Xtr_sel = jax.numpy.take(X_train, jax.numpy.asarray(sel_idx), axis=1)
     Xte_sel = jax.numpy.take(X_test, jax.numpy.asarray(sel_idx), axis=1)
     base = cfg.gbdt.replace(scale_pos_weight=spw)
-    search = randomized_search(
-        Xtr_sel, y_train, base, cfg.tune, mesh  # callee fetches y once
-    )
+    if skip_search:
+        # The search's expensive part (20x3 CV fan-out) is checkpointed as
+        # its best params; the final estimator is a single refit with them —
+        # exactly what `randomized_search` itself does after CV.
+        from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
+
+        extra = ckpt.load("search")["extra"]
+        best_params = dict(extra["best_params"])
+        est = GBDTClassifier(base.replace(**best_params))
+        est.fit(Xtr_sel, np.asarray(y_train))
+        search = SearchResult(
+            best_params_=best_params,
+            best_score_=float(extra["cv_auc"]),
+            best_estimator_=est,
+            cv_results_={},
+        )
+        stages_skipped.append("search")
+        logger.info("resume: restored best params %s, refit only", best_params)
+        t = tick("refit", t)
+    else:
+        search = randomized_search(
+            Xtr_sel, y_train, base, cfg.tune, mesh  # callee fetches y once
+        )
+        if ckpt is not None:
+            ckpt.write(
+                "search",
+                fingerprint=fp_search,
+                extra={
+                    "best_params": search.best_params_,
+                    "cv_auc": float(search.best_score_),
+                },
+            )
+        stages_run.append("search")
+        t = tick("search", t)
     logger.info(
         "search best CV AUC %.4f with %s", search.best_score_, search.best_params_
     )
-    t = tick("search", t)
 
     # --- final eval (model_tree_train_test.py:171-179) ----------------------
     est = search.best_estimator_
@@ -176,6 +345,7 @@ def run_pipeline(
         "best_params": search.best_params_,
     }
     logger.info("test ROC-AUC %.4f", test_auc)
+    stages_run.append("eval")
     t = tick("eval", t)
 
     artifact = GBDTArtifact(
@@ -232,6 +402,8 @@ def run_pipeline(
         search=search,
         scale_pos_weight=spw,
         timings=timings,
+        stages_run=tuple(stages_run),
+        stages_skipped=tuple(stages_skipped),
     )
 
 
@@ -247,6 +419,12 @@ def main(argv=None) -> PipelineResult:
         help="generate a synthetic raw table instead of loading raw_key",
     )
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--resume",
+        action="store_true",
+        help="skip stages whose checkpoint manifests still validate (crash "
+        "recovery: restart from the last good stage instead of raw data)",
+    )
     parser.add_argument(
         "--quick",
         action="store_true",
@@ -287,7 +465,7 @@ def main(argv=None) -> PipelineResult:
 
         raw = synthetic_lendingclub_frame(args.synthetic_rows, seed=args.seed)
     store = ObjectStore(args.store) if args.store else None
-    result = run_pipeline(cfg, raw=raw, store=store)
+    result = run_pipeline(cfg, raw=raw, store=store, resume=args.resume)
     print(
         {
             "test_auc": result.test_auc,
@@ -295,6 +473,8 @@ def main(argv=None) -> PipelineResult:
             "best_params": result.best_params,
             "n_selected": len(result.selected_features),
             "timings": result.timings,
+            "stages_run": result.stages_run,
+            "stages_skipped": result.stages_skipped,
         }
     )
     return result

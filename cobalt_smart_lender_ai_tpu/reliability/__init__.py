@@ -1,0 +1,57 @@
+"""Resilience layer (SURVEY gap: "no checkpoint/resume, no fault tolerance").
+
+The reference dies on the first transient failure anywhere: an S3 read that
+times out kills a preprocessing script, a crash mid-search throws away hours
+of RFE work, and a SHAP failure at serve time 500s the request. This package
+provides the four primitives the rest of the framework wires in:
+
+- `retry` — `RetryPolicy` (bounded attempts, exponential backoff + jitter,
+  deadline, retryable-exception predicate) and `call_with_retry`, with the
+  clock/sleep/rng injectable so tests never sleep for real.
+- `stores` — `ResilientStore`, an `ObjectStore` wrapper that retries
+  transient failures per the policy and verifies content-addressed
+  `<key>.ptr.json` pointers on read (a corrupted read is retried, not
+  silently consumed).
+- `faults` — `FaultInjectingStore`, a seeded, deterministic test double that
+  injects failure-rate / fail-after-N / corrupted-bytes faults per
+  operation, so every resilience claim in the test suite is exercised under
+  real (injected) faults instead of asserted.
+- `checkpoint` — `PipelineCheckpoint`: per-stage manifests (outputs, md5+size
+  pointers, config fingerprint) that `pipeline.run_pipeline` writes after
+  each stage and its `--resume` path validates to skip stages whose outputs
+  still verify.
+"""
+
+from cobalt_smart_lender_ai_tpu.reliability.checkpoint import (
+    PipelineCheckpoint,
+    config_fingerprint,
+)
+from cobalt_smart_lender_ai_tpu.reliability.faults import (
+    FaultInjectingStore,
+    FaultSpec,
+    InjectedFault,
+)
+from cobalt_smart_lender_ai_tpu.reliability.retry import (
+    RetryPolicy,
+    call_with_retry,
+    is_transient_store_error,
+    policy_from_config,
+)
+from cobalt_smart_lender_ai_tpu.reliability.stores import (
+    CorruptObjectError,
+    ResilientStore,
+)
+
+__all__ = [
+    "CorruptObjectError",
+    "FaultInjectingStore",
+    "FaultSpec",
+    "InjectedFault",
+    "PipelineCheckpoint",
+    "ResilientStore",
+    "RetryPolicy",
+    "call_with_retry",
+    "config_fingerprint",
+    "is_transient_store_error",
+    "policy_from_config",
+]

@@ -1,0 +1,124 @@
+"""Pipeline stage manifests — checkpoint/resume for `run_pipeline`.
+
+After each stage the pipeline writes ``<prefix><stage>.json`` to the object
+store: the stage's output keys with md5+size pointers, a fingerprint of the
+config slice the stage depends on, and a small ``extra`` payload for stages
+whose result is data rather than store objects (RFE's selected features,
+the search's best params). On ``--resume`` a stage is skipped iff its
+manifest exists, the fingerprint still matches, and every output object's
+bytes still hash to the pinned md5 — so a crash mid-RFE or mid-search
+restarts from the last good stage instead of from raw data, and a config
+change invalidates exactly the stages that depend on it.
+
+Manifest format (``"format": 1``)::
+
+    {
+      "format": 1,
+      "stage": "engineer",
+      "fingerprint": "9f3a...",
+      "outputs": ["dataset/2-intermediate/cleaned_02_tree.csv", ...],
+      "pointers": {"<key>": {"key": ..., "md5": ..., "size": ...}, ...},
+      "extra": {...}
+    }
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+from typing import Any, Mapping, Sequence
+
+from cobalt_smart_lender_ai_tpu.io.store import ObjectStore
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_FORMAT = 1
+
+
+def config_fingerprint(*parts: Any) -> str:
+    """Stable hex digest of config dataclasses / plain JSON-able values.
+
+    Dataclasses are flattened with `dataclasses.asdict`; anything JSON can't
+    serialize falls back to ``str`` — the goal is change *detection*, not a
+    canonical encoding."""
+    norm = [
+        dataclasses.asdict(p) if dataclasses.is_dataclass(p) else p for p in parts
+    ]
+    payload = json.dumps(norm, sort_keys=True, default=str).encode()
+    return hashlib.sha256(payload).hexdigest()[:16]
+
+
+class PipelineCheckpoint:
+    """Read/write/validate per-stage manifests in an object store."""
+
+    def __init__(self, store: ObjectStore, prefix: str = "checkpoints/"):
+        self.store = store
+        self.prefix = prefix
+
+    def manifest_key(self, stage: str) -> str:
+        return f"{self.prefix}{stage}.json"
+
+    def write(
+        self,
+        stage: str,
+        *,
+        fingerprint: str,
+        outputs: Sequence[str] = (),
+        extra: Mapping[str, Any] | None = None,
+    ) -> dict:
+        """Pin each output's current content (also writing its
+        ``<key>.ptr.json`` so `ResilientStore` verifies later reads) and
+        persist the stage manifest."""
+        pointers = {key: self.store.write_pointer(key) for key in outputs}
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "stage": stage,
+            "fingerprint": fingerprint,
+            "outputs": list(outputs),
+            "pointers": pointers,
+            "extra": dict(extra or {}),
+        }
+        self.store.put_json(self.manifest_key(stage), manifest)
+        return manifest
+
+    def load(self, stage: str) -> dict | None:
+        """The stage's manifest, or None when missing/unreadable/foreign."""
+        try:
+            manifest = self.store.get_json(self.manifest_key(stage))
+        except Exception:
+            return None
+        if (
+            not isinstance(manifest, dict)
+            or manifest.get("format") != MANIFEST_FORMAT
+        ):
+            return None
+        return manifest
+
+    def valid(self, stage: str, fingerprint: str) -> bool:
+        """True iff the stage can be skipped: manifest present, config slice
+        unchanged, and every pinned output still hashes to its manifest md5
+        (checked against the manifest itself, not the mutable ``.ptr.json``,
+        so a rewritten pointer cannot launder drifted bytes)."""
+        manifest = self.load(stage)
+        if manifest is None or manifest.get("fingerprint") != fingerprint:
+            return False
+        for key in manifest.get("outputs", []):
+            ptr = manifest.get("pointers", {}).get(key)
+            if not ptr:
+                return False
+            try:
+                data = self.store.get_bytes(key)
+            except Exception:
+                return False
+            if (
+                hashlib.md5(data).hexdigest() != ptr.get("md5")
+                or len(data) != ptr.get("size")
+            ):
+                logger.info("checkpoint %s: output %s drifted", stage, key)
+                return False
+        return True
+
+    def invalidate(self, stage: str) -> None:
+        self.store.delete(self.manifest_key(stage))

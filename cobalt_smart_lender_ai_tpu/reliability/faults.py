@@ -1,0 +1,123 @@
+"""`FaultInjectingStore` — seeded, deterministic fault injection for tests.
+
+Every resilience claim in the tree is exercised under injected faults
+(tests/test_reliability.py) rather than asserted: a pipeline run against a
+store that drops ~one in five calls must still complete, a corrupted read
+must be detected by pointer verification and healed by a retry. The double
+is deterministic — one `random.Random(seed)` drawn once per rate-gated call
+in call order — so a failing seed reproduces exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from collections import Counter
+from typing import Iterator, Mapping
+
+from cobalt_smart_lender_ai_tpu.io.store import ObjectStore
+
+
+class InjectedFault(ConnectionError):
+    """Deliberate transient failure (ConnectionError so the default retry
+    predicate classifies it transient, like a dropped backend connection)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """Fault profile for one store operation.
+
+    - ``rate`` — probability an individual call raises `InjectedFault`.
+    - ``fail_after`` — deterministic variant: the first N calls succeed,
+      every later call faults (until ``max_faults`` is spent).
+    - ``corrupt_rate`` — ``get`` only: probability the returned bytes are
+      corrupted (first byte flipped) instead of raising.
+    - ``max_faults`` — total fault budget for the operation; ``None`` means
+      unbounded. A bounded budget guarantees eventual success under retry.
+    """
+
+    rate: float = 0.0
+    fail_after: int | None = None
+    corrupt_rate: float = 0.0
+    max_faults: int | None = None
+
+
+class FaultInjectingStore(ObjectStore):
+    """Wraps any `ObjectStore`; injects faults per-operation per `FaultSpec`.
+
+    ``faults`` maps operation name (``"put"``, ``"get"``, ``"exists"``,
+    ``"delete"``, ``"list"``) to its spec; unlisted operations run clean.
+    ``calls`` / ``injected`` are per-operation counters tests assert against.
+    """
+
+    OPS = ("put", "get", "exists", "delete", "list")
+
+    def __new__(cls, *args, **kwargs):  # bypass ObjectStore's URI dispatch
+        return object.__new__(cls)
+
+    def __init__(
+        self,
+        inner: ObjectStore,
+        *,
+        seed: int = 0,
+        faults: Mapping[str, FaultSpec] | None = None,
+    ):
+        self.inner = inner
+        self.uri = inner.uri
+        self.faults = dict(faults or {})
+        unknown = set(self.faults) - set(self.OPS)
+        if unknown:
+            raise ValueError(f"unknown fault ops {sorted(unknown)}; use {self.OPS}")
+        self._rng = random.Random(seed)
+        self.calls: Counter[str] = Counter()
+        self.injected: Counter[str] = Counter()
+
+    # -- fault engine ---------------------------------------------------------
+    def _budget_left(self, op: str, spec: FaultSpec) -> bool:
+        return spec.max_faults is None or self.injected[op] < spec.max_faults
+
+    def _inject(self, op: str) -> None:
+        """Count the call; raise if this call draws a fault."""
+        self.calls[op] += 1
+        spec = self.faults.get(op)
+        if spec is None or not self._budget_left(op, spec):
+            return
+        if spec.fail_after is not None and self.calls[op] > spec.fail_after:
+            self.injected[op] += 1
+            raise InjectedFault(f"injected {op} fault (call {self.calls[op]})")
+        if spec.rate and self._rng.random() < spec.rate:
+            self.injected[op] += 1
+            raise InjectedFault(f"injected {op} fault (call {self.calls[op]})")
+
+    def _maybe_corrupt(self, data: bytes) -> bytes:
+        spec = self.faults.get("get")
+        if (
+            spec is not None
+            and spec.corrupt_rate
+            and self._budget_left("get", spec)
+            and self._rng.random() < spec.corrupt_rate
+        ):
+            self.injected["get"] += 1
+            return bytes([data[0] ^ 0xFF]) + data[1:] if data else b"\x00"
+        return data
+
+    # -- byte-blob contract ---------------------------------------------------
+    def put_bytes(self, key: str, data: bytes) -> None:
+        self._inject("put")
+        self.inner.put_bytes(key, data)
+
+    def get_bytes(self, key: str) -> bytes:
+        self._inject("get")
+        return self._maybe_corrupt(self.inner.get_bytes(key))
+
+    def exists(self, key: str) -> bool:
+        self._inject("exists")
+        return self.inner.exists(key)
+
+    def delete(self, key: str) -> None:
+        self._inject("delete")
+        self.inner.delete(key)
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        self._inject("list")
+        return self.inner.list(prefix)

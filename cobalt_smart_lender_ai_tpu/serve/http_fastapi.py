@@ -97,4 +97,17 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
         except ValidationError as e:
             raise HTTPException(status_code=400, detail=str(e))
 
+    @app.get("/healthz")
+    def healthz():
+        return state["service"].health()
+
+    @app.get("/readyz")
+    def readyz():
+        ready, payload = state["service"].ready()
+        if not ready:
+            # degraded SHAP alone stays 200 (probabilities still served);
+            # 503 means the instance cannot score at all
+            raise HTTPException(status_code=503, detail=payload)
+        return payload
+
     return app

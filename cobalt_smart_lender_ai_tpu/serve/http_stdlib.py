@@ -96,7 +96,12 @@ def make_handler(service: ScorerService):
 
         def do_GET(self):  # noqa: N802
             if self.path == "/healthz":
-                self._send(200, {"status": "ok"})
+                self._send(200, service.health())
+            elif self.path == "/readyz":
+                ready, payload = service.ready()
+                # degraded-but-scorable is still 200: readiness gates traffic
+                # on the probability contract, not the SHAP enrichment
+                self._send(200 if ready else 503, payload)
             else:
                 self._send(404, {"detail": "Not Found"})
 

@@ -106,9 +106,24 @@ class ScorerService:
         self._margin_fn = jax.jit(lambda X: predict_margin(forest, X)).lower(
             jax.ShapeDtypeStruct((1, self._n_features), jnp.float32)
         ).compile()
-        self._shap_fn = jax.jit(
-            lambda X: shap_values(forest, X, n_features=self._n_features)
-        ).lower(jax.ShapeDtypeStruct((1, self._n_features), jnp.float32)).compile()
+        # SHAP is the one *optional* device program: probabilities are the
+        # service's contract, attributions are an enrichment. With
+        # `reliability.degrade_shap` (default), a SHAP compile failure leaves
+        # the service up in degraded mode instead of failing startup — the
+        # margin program above has no such net; without a scorer there is
+        # nothing to serve.
+        self._shap_fn = None
+        self._shap_error: str | None = None
+        try:
+            self._shap_fn = jax.jit(
+                lambda X: shap_values(forest, X, n_features=self._n_features)
+            ).lower(
+                jax.ShapeDtypeStruct((1, self._n_features), jnp.float32)
+            ).compile()
+        except Exception as exc:
+            if not self.config.reliability.degrade_shap:
+                raise
+            self._shap_error = f"{type(exc).__name__}: {exc}"
         # Batch scoring pads every request to a power-of-two row bucket, so
         # the compile count is bounded by log2(max_batch_rows) over the
         # service's whole lifetime — NOT one XLA compile (tens of seconds on
@@ -194,6 +209,34 @@ class ScorerService:
             out[start : start + n] = np.asarray(jax.nn.sigmoid(margin))[:n]
         return out
 
+    # -- health / readiness ---------------------------------------------------
+
+    def health(self) -> dict:
+        """`GET /healthz` — liveness: the process is up and the service
+        object is constructed. Always ``{"status": "ok"}``; a dead process
+        cannot answer at all, which is the signal."""
+        return {"status": "ok"}
+
+    def ready(self) -> tuple[bool, dict]:
+        """`GET /readyz` — readiness: can this instance score traffic *now*?
+
+        Ready iff the margin program is compiled (it always is once __init__
+        returns). A degraded SHAP program does NOT fail readiness — the
+        instance still serves its probability contract — but it is reported
+        so orchestrators and dashboards can see the degradation."""
+        ready = self._margin_fn is not None
+        payload = {
+            "status": "ok" if ready else "unavailable",
+            "model_key": self.config.model_key,
+            "n_features": self._n_features,
+            "compiled_batch_buckets": list(self.compiled_batch_buckets),
+            "shap": "ok" if self._shap_fn is not None else "degraded",
+            "degraded": self._shap_fn is None,
+        }
+        if self._shap_error is not None:
+            payload["shap_error"] = self._shap_error
+        return ready, payload
+
     # -- endpoint handlers ----------------------------------------------------
 
     def predict_single(self, payload: Mapping[str, Any]) -> dict:
@@ -202,17 +245,35 @@ class ScorerService:
         row = validate_single_input(payload)
         x = self._row_array(row)
         margin = self._margin_fn(jnp.asarray(x))
-        phis, base = self._shap_fn(jnp.asarray(x))
-        return {
+        resp = {
             "prob_default": float(jax.nn.sigmoid(margin)[0]),
-            "shap_values": np.asarray(phis)[0].tolist(),
-            "base_value": float(base),
             "features": list(self.feature_names),
             # Echo of the validated request (the reference echoes its input
             # df row). Keyed by the schema's canonical names, which equal the
             # model features for the deployed 20-feature contract.
             "input_row": dict(row),
         }
+        # Graceful degradation: the probability IS the serving contract; SHAP
+        # failing (compile-time above, or execution here) must not turn a
+        # scorable request into HTTP 500. Degraded responses carry
+        # `"shap_values": null` plus a `degraded` flag; healthy responses keep
+        # the reference's exact key set (no flag), which existing clients
+        # assert on.
+        try:
+            if self._shap_fn is None:
+                raise RuntimeError(self._shap_error or "SHAP program unavailable")
+            phis, base = self._shap_fn(jnp.asarray(x))
+            resp["shap_values"] = np.asarray(phis)[0].tolist()
+            resp["base_value"] = float(base)
+        except Exception as exc:
+            if not self.config.reliability.degrade_shap:
+                raise
+            if self._shap_error is None:
+                self._shap_error = f"{type(exc).__name__}: {exc}"
+            resp["shap_values"] = None
+            resp["base_value"] = None
+            resp["degraded"] = True
+        return resp
 
     def predict_bulk_csv(self, csv_bytes: bytes) -> dict:
         """`POST /predict_bulk_csv` (cobalt_fast_api.py:113-126): CSV in,

@@ -211,14 +211,39 @@ class ApiClient:
     calls the reference UI makes (cobalt_streamlit.py:85,140,159), pulled out
     so tests can exercise the full wire path in-process."""
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 30.0,
+        retries: int = 3,
+        backoff_s: float = 0.2,
+        sleep=None,
+    ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self._sleep = sleep  # injectable for tests; None = time.sleep
 
     def _post(self, path: str, **kwargs) -> Any:
+        import time
+
         import requests
 
-        r = requests.post(self.base_url + path, timeout=self.timeout, **kwargs)
+        # Retry ONLY connection-level failures (server restarting, transient
+        # network) with exponential backoff. HTTP error statuses are real
+        # answers — a 422 will not get better by asking again.
+        sleep = self._sleep or time.sleep
+        for attempt in range(self.retries):
+            try:
+                r = requests.post(
+                    self.base_url + path, timeout=self.timeout, **kwargs
+                )
+                break
+            except requests.exceptions.ConnectionError:
+                if attempt == self.retries - 1:
+                    raise
+                sleep(self.backoff_s * (2**attempt))
         r.raise_for_status()
         return r.json()
 

@@ -21,6 +21,7 @@ import numpy as np  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
 from cobalt_smart_lender_ai_tpu.config import MeshConfig  # noqa: E402
+from cobalt_smart_lender_ai_tpu.parallel.compat import shard_map  # noqa: E402
 from cobalt_smart_lender_ai_tpu.parallel.distributed import (  # noqa: E402
     init_distributed,
     make_global_mesh,
@@ -46,7 +47,7 @@ def main() -> None:
 
     @jax.jit
     @partial(
-        jax.shard_map, mesh=mesh, in_specs=P(None, "dp"), out_specs=P(None, "dp")
+        shard_map, mesh=mesh, in_specs=P(None, "dp"), out_specs=P(None, "dp")
     )
     def total(x):
         return jax.numpy.broadcast_to(jax.lax.psum(x.sum(), "dp"), x.shape)

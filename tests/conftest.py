@@ -72,7 +72,9 @@ def serving_artifact(tmp_path_factory, engineered):
         feature_names=tuple(schema.SERVING_FEATURES),
     )
     art.save(store, "models/gbdt/model_tree")
-    return store, np.asarray(ff.X)
+    # np.array, not np.asarray: asarray zero-copies the device buffer and the
+    # result is read-only — consumers (bulk-CSV test) mutate their frames.
+    return store, np.array(ff.X)
 
 
 @pytest.fixture(scope="session")

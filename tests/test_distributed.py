@@ -93,6 +93,15 @@ def test_two_process_bootstrap_and_psum():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    if any(
+        "Multiprocess computations aren't implemented on the CPU backend" in out
+        for out in outs
+    ):
+        # The bootstrap itself succeeded (two processes formed one runtime and
+        # reached the collective); this jaxlib's CPU backend simply cannot
+        # EXECUTE cross-process computations. Newer jaxlibs can — skip, don't
+        # fail, on the capability gap.
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"RANK{rank}_PSUM_OK=3.0" in out, out

@@ -1,0 +1,755 @@
+"""Resilience layer (reliability/) under test.
+
+Every claim is exercised, not asserted: the backoff schedule is checked
+against a fake clock (tier-1 never sleeps for real), fault injection is
+replayed under a fixed seed, a pipeline run against a store that drops calls
+must complete *via retries* (observable counter), a run killed after RFE
+must resume without re-running clean/engineer/RFE (stage-execution
+counters), and a service whose SHAP program is broken must still serve
+probabilities over both HTTP adapters with ``"degraded": true`` instead of
+HTTP 500.
+"""
+
+import json
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from cobalt_smart_lender_ai_tpu.config import (
+    GBDTConfig,
+    MeshConfig,
+    PipelineConfig,
+    ReliabilityConfig,
+    RFEConfig,
+    TuneConfig,
+)
+from cobalt_smart_lender_ai_tpu.io import ObjectStore, StoreKeyError
+from cobalt_smart_lender_ai_tpu.reliability import (
+    CorruptObjectError,
+    FaultInjectingStore,
+    FaultSpec,
+    InjectedFault,
+    PipelineCheckpoint,
+    ResilientStore,
+    RetryPolicy,
+    call_with_retry,
+    config_fingerprint,
+)
+
+
+class FakeClock:
+    """Deterministic sleep/monotonic pair: sleeping advances the clock."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps: list[float] = []
+
+    def sleep(self, s: float) -> None:
+        self.sleeps.append(s)
+        self.now += s
+
+    def monotonic(self) -> float:
+        return self.now
+
+
+# --- retry policy -------------------------------------------------------------
+
+
+def test_backoff_schedule_exponential_capped():
+    """base * mult^i capped at max_delay, asserted against the fake clock."""
+    clock = FakeClock()
+    policy = RetryPolicy(
+        max_attempts=5, base_delay_s=1.0, max_delay_s=5.0, multiplier=2.0, jitter=0.0
+    )
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        call_with_retry(
+            flaky, policy, sleep=clock.sleep, monotonic=clock.monotonic
+        )
+    assert len(calls) == 5
+    assert clock.sleeps == [1.0, 2.0, 4.0, 5.0]  # 8.0 capped to max_delay
+
+
+def test_jitter_deterministic_under_seed():
+    policy = RetryPolicy(base_delay_s=1.0, jitter=0.5)
+    a = [policy.delay(i, random.Random(7)) for i in range(4)]
+    b = [policy.delay(i, random.Random(7)) for i in range(4)]
+    c = [policy.delay(i, random.Random(8)) for i in range(4)]
+    assert a == b != c
+    for i, d in enumerate(a):  # within the documented [1-j, 1+j] band
+        raw = min(1.0 * 2.0**i, policy.max_delay_s)
+        assert raw * 0.5 <= d <= raw * 1.5
+
+
+def test_succeeds_midway_returns_value():
+    clock = FakeClock()
+    state = {"n": 0}
+
+    def eventually():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise TimeoutError("later")
+        return "ok"
+
+    assert (
+        call_with_retry(
+            eventually,
+            RetryPolicy(max_attempts=4, jitter=0.0),
+            sleep=clock.sleep,
+            monotonic=clock.monotonic,
+        )
+        == "ok"
+    )
+    assert state["n"] == 3 and len(clock.sleeps) == 2
+
+
+def test_non_retryable_raises_immediately():
+    clock = FakeClock()
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError("no such object")
+
+    with pytest.raises(FileNotFoundError):
+        call_with_retry(
+            missing, RetryPolicy(max_attempts=5), sleep=clock.sleep,
+            monotonic=clock.monotonic,
+        )
+    assert len(calls) == 1 and clock.sleeps == []
+
+
+def test_deadline_caps_wall_time():
+    clock = FakeClock()
+    policy = RetryPolicy(
+        max_attempts=10, base_delay_s=1.0, max_delay_s=10.0, multiplier=2.0,
+        jitter=0.0, deadline_s=4.0,
+    )
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        call_with_retry(flaky, policy, sleep=clock.sleep, monotonic=clock.monotonic)
+    # sleeps 1 + 2 taken; the next delay (4) would cross the 4s deadline
+    assert clock.sleeps == [1.0, 2.0]
+    assert len(calls) == 3
+
+
+def test_store_key_error_not_retryable():
+    from cobalt_smart_lender_ai_tpu.reliability.retry import is_transient_store_error
+
+    assert not is_transient_store_error(StoreKeyError("escape"))
+    assert not is_transient_store_error(ValueError("bad"))
+    assert is_transient_store_error(InjectedFault("drop"))
+    assert is_transient_store_error(CorruptObjectError("mismatch"))
+
+
+# --- fault injection ----------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_fault_injection_deterministic_under_seed(tmp_path):
+    def run(seed: int) -> tuple:
+        inner = ObjectStore(str(tmp_path / f"lake{seed}"))
+        store = FaultInjectingStore(
+            inner, seed=seed, faults={"put": FaultSpec(rate=0.5)}
+        )
+        outcomes = []
+        for i in range(30):
+            try:
+                store.put_bytes(f"k{i}", b"v")
+                outcomes.append("ok")
+            except InjectedFault:
+                outcomes.append("fault")
+        return tuple(outcomes)
+
+    # replaying the same seed reproduces the exact fault sequence; a
+    # different seed draws a different one
+    assert run(3) == run(3)
+    assert run(3) != run(4)
+
+
+@pytest.mark.faults
+def test_fail_after_and_budget(tmp_path):
+    inner = ObjectStore(str(tmp_path / "lake"))
+    store = FaultInjectingStore(
+        inner, faults={"exists": FaultSpec(fail_after=2, max_faults=3)}
+    )
+    assert store.exists("a") is False  # calls 1-2 clean
+    assert store.exists("a") is False
+    for _ in range(3):  # calls 3-5 fault (budget of 3)
+        with pytest.raises(InjectedFault):
+            store.exists("a")
+    assert store.exists("a") is False  # budget spent: clean again
+    assert store.injected["exists"] == 3
+
+
+@pytest.mark.faults
+def test_corruption_detected_by_pointer_verification(tmp_path):
+    inner = ObjectStore(str(tmp_path / "lake"))
+    inner.put_bytes("data.bin", b"payload")
+    inner.write_pointer("data.bin")
+    faulty = FaultInjectingStore(
+        inner, seed=1, faults={"get": FaultSpec(corrupt_rate=1.0, max_faults=2)}
+    )
+    resilient = ResilientStore(
+        faulty, RetryPolicy(max_attempts=6, base_delay_s=0.0, jitter=0.0)
+    )
+    # first two reads of the data return flipped bytes -> CorruptObjectError
+    # -> retried until the budget is spent and a clean read verifies
+    assert resilient.get_bytes("data.bin") == b"payload"
+    assert resilient.retries > 0
+    assert faulty.injected["get"] == 2
+
+
+# --- resilient store ----------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_resilient_store_retries_transient_faults(tmp_path):
+    inner = ObjectStore(str(tmp_path / "lake"))
+    faulty = FaultInjectingStore(
+        inner,
+        seed=5,
+        faults={"put": FaultSpec(rate=0.3), "get": FaultSpec(rate=0.3)},
+    )
+    store = ResilientStore(
+        faulty, RetryPolicy(max_attempts=8, base_delay_s=0.0, jitter=0.0)
+    )
+    for i in range(40):
+        store.put_bytes(f"obj/{i}", f"value-{i}".encode())
+    for i in range(40):
+        assert store.get_bytes(f"obj/{i}") == f"value-{i}".encode()
+    assert store.retries > 0, "fault rate 0.3 over 80 calls must trigger retries"
+    assert faulty.injected["put"] > 0 and faulty.injected["get"] > 0
+
+
+def test_resilient_store_does_not_retry_missing_objects(tmp_path):
+    inner = ObjectStore(str(tmp_path / "lake"))
+    counting = FaultInjectingStore(inner)  # no faults, just call counters
+    store = ResilientStore(counting, RetryPolicy(base_delay_s=0.0))
+    with pytest.raises(FileNotFoundError):
+        store.get_bytes("never/written")
+    assert counting.calls["get"] == 1  # deterministic failure: one attempt
+    assert store.retries == 0
+
+
+def test_resilient_store_detects_persistent_corruption(tmp_path):
+    inner = ObjectStore(str(tmp_path / "lake"))
+    inner.put_bytes("k", b"original")
+    inner.write_pointer("k")
+    inner.put_bytes("k", b"tampered!")  # rewrite WITHOUT re-pinning
+    store = ResilientStore(
+        inner, RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+    )
+    with pytest.raises(CorruptObjectError):
+        store.get_bytes("k")
+    assert store.get_bytes("k" + ".ptr.json")  # pointer itself still readable
+
+
+def test_resilient_store_inherits_conveniences(tmp_path):
+    """put_json/save_frame etc. compose over the retried primitives."""
+    import pandas as pd
+
+    store = ResilientStore(
+        ObjectStore(str(tmp_path / "lake")), RetryPolicy(base_delay_s=0.0)
+    )
+    store.put_json("m.json", {"a": 1})
+    assert store.get_json("m.json") == {"a": 1}
+    store.save_frame("f.csv", pd.DataFrame({"x": [1, 2]}))
+    assert list(store.load_frame("f.csv")["x"]) == [1, 2]
+    assert "m.json" in list(store.list(""))
+
+
+# --- store satellites ---------------------------------------------------------
+
+
+def test_store_key_escape_rejected(tmp_path):
+    store = ObjectStore(str(tmp_path / "lake"))
+    for bad in ("/etc/passwd", "a/../../b", "..", "../x", "\\\\evil"):
+        with pytest.raises(StoreKeyError):
+            store.put_bytes(bad, b"x")
+    # StoreKeyError stays a ValueError for existing callers
+    with pytest.raises(ValueError):
+        store.get_bytes("../y")
+    # dots WITHIN a segment are legal keys
+    store.put_bytes("a..b/c.txt", b"ok")
+    assert store.get_bytes("a..b/c.txt") == b"ok"
+
+
+def test_verify_pointer_never_raises(tmp_path):
+    store = ObjectStore(str(tmp_path / "lake"))
+    assert store.verify_pointer("absent") is False  # no pointer, no object
+    store.put_bytes("k", b"v")
+    assert store.verify_pointer("k") is False  # object but no pointer
+    store.write_pointer("k")
+    assert store.verify_pointer("k") is True
+    store.put_bytes("k" + ".ptr.json", b"{not json")
+    assert store.verify_pointer("k") is False  # malformed pointer
+    store.put_bytes("k2", b"v")
+    store.write_pointer("k2")
+    store.delete("k2")  # key gone, pointer dangling
+    assert store.verify_pointer("k2") is False
+
+
+def test_concurrent_put_bytes_no_temp_collision(tmp_path):
+    """Concurrent writers of the SAME key must not truncate each other via a
+    shared temp name; the survivor is one complete payload, no .tmp left."""
+    store = ObjectStore(str(tmp_path / "lake"))
+    payloads = [bytes([i]) * 4096 for i in range(16)]
+    errors = []
+
+    def write(data: bytes):
+        try:
+            for _ in range(20):
+                store.put_bytes("contended/key.bin", data)
+        except Exception as e:  # pragma: no cover - the regression we guard
+            errors.append(e)
+
+    threads = [threading.Thread(target=write, args=(p,)) for p in payloads]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    assert store.get_bytes("contended/key.bin") in payloads
+    leftovers = [k for k in store.list("") if k.endswith(".tmp")]
+    assert leftovers == []
+
+
+# --- checkpoint manifests -----------------------------------------------------
+
+
+def test_config_fingerprint_sensitivity():
+    a = config_fingerprint("rfe", RFEConfig())
+    assert a == config_fingerprint("rfe", RFEConfig())
+    assert a != config_fingerprint("rfe", RFEConfig(n_select=10))
+    assert a != config_fingerprint("search", RFEConfig())
+
+
+def test_manifest_validates_and_invalidates(tmp_path):
+    store = ObjectStore(str(tmp_path / "lake"))
+    ckpt = PipelineCheckpoint(store, prefix="ck/")
+    store.put_bytes("out.csv", b"rows")
+    fp = config_fingerprint("stage", {"k": 1})
+    ckpt.write("stage", fingerprint=fp, outputs=["out.csv"], extra={"n": 3})
+    assert ckpt.valid("stage", fp)
+    assert ckpt.load("stage")["extra"] == {"n": 3}
+    # changed config slice -> invalid
+    assert not ckpt.valid("stage", config_fingerprint("stage", {"k": 2}))
+    # drifted output bytes -> invalid even though fingerprint matches
+    store.put_bytes("out.csv", b"drifted")
+    assert not ckpt.valid("stage", fp)
+    # missing manifest -> load None, valid False
+    ckpt.invalidate("stage")
+    assert ckpt.load("stage") is None and not ckpt.valid("stage", fp)
+
+
+def test_manifest_foreign_format_ignored(tmp_path):
+    store = ObjectStore(str(tmp_path / "lake"))
+    ckpt = PipelineCheckpoint(store)
+    store.put_json(ckpt.manifest_key("clean"), {"format": 999})
+    assert ckpt.load("clean") is None
+    store.put_bytes(ckpt.manifest_key("clean"), b"not json")
+    assert ckpt.load("clean") is None
+
+
+# --- pipeline checkpoint/resume ----------------------------------------------
+
+
+def _tiny_pipeline_config(**rel_kw) -> PipelineConfig:
+    """Smallest config that still walks every stage."""
+    return PipelineConfig(
+        gbdt=GBDTConfig(n_bins=32),
+        rfe=RFEConfig(n_select=10, step=40, n_estimators=8, max_depth=3),
+        tune=TuneConfig(
+            n_iter=2,
+            cv_folds=2,
+            param_space={
+                "n_estimators": (40,),
+                "max_depth": (3,),
+                "learning_rate": (0.1,),
+            },
+        ),
+        mesh=MeshConfig(hp=1),
+        reliability=ReliabilityConfig(
+            base_delay_s=0.0, max_delay_s=0.0, jitter=0.0, **rel_kw
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def small_raw():
+    from cobalt_smart_lender_ai_tpu.data.synthetic import (
+        synthetic_lendingclub_frame,
+    )
+
+    return synthetic_lendingclub_frame(2500, seed=11)
+
+
+def test_resume_after_crash_skips_completed_stages(tmp_path, small_raw, monkeypatch):
+    """ISSUE acceptance: a run killed after the RFE stage resumes with
+    --resume without re-running clean/engineer/RFE."""
+    import cobalt_smart_lender_ai_tpu.pipeline as pl
+
+    cfg = _tiny_pipeline_config()
+    store = ObjectStore(str(tmp_path / "lake"))
+
+    def boom(*a, **k):
+        raise RuntimeError("killed mid-search")
+
+    monkeypatch.setattr(pl, "randomized_search", boom)
+    with pytest.raises(RuntimeError, match="killed mid-search"):
+        pl.run_pipeline(cfg, raw=small_raw, store=store)
+    monkeypatch.undo()
+
+    # crash left manifests for every completed stage
+    ckpt = PipelineCheckpoint(store, cfg.reliability.checkpoint_prefix)
+    for stage in ("clean", "engineer", "rfe"):
+        assert ckpt.load(stage) is not None, stage
+
+    result = pl.run_pipeline(cfg, store=store, resume=True)  # no raw needed
+    assert set(result.stages_skipped) >= {"clean", "engineer", "rfe"}
+    assert "rfe" not in result.stages_run
+    assert set(result.stages_run) >= {"search", "eval"}
+    assert len(result.selected_features) == cfg.rfe.n_select
+    assert result.test_auc > 0.85
+
+
+def test_resume_full_run_then_config_change(tmp_path, small_raw):
+    """A fully-successful run resumes clean through search; changing only the
+    RFE config re-runs RFE + search while clean/engineer stay skipped."""
+    import dataclasses
+
+    from cobalt_smart_lender_ai_tpu.pipeline import run_pipeline
+
+    cfg = _tiny_pipeline_config()
+    store = ObjectStore(str(tmp_path / "lake"))
+    first = run_pipeline(cfg, raw=small_raw, store=store)
+    assert first.stages_skipped == ()
+    assert set(first.stages_run) == {"clean", "engineer", "rfe", "search", "eval"}
+
+    second = run_pipeline(cfg, store=store, resume=True)
+    assert set(second.stages_skipped) == {"clean", "engineer", "rfe", "search"}
+    assert second.stages_run == ("eval",)
+    assert second.selected_features == first.selected_features
+    assert second.best_params == first.best_params
+    assert second.cv_auc == first.cv_auc
+
+    changed = dataclasses.replace(
+        cfg, rfe=dataclasses.replace(cfg.rfe, n_select=8)
+    )
+    third = run_pipeline(changed, store=store, resume=True)
+    assert set(third.stages_skipped) == {"clean", "engineer"}
+    assert set(third.stages_run) == {"rfe", "search", "eval"}
+    assert len(third.selected_features) == 8
+
+
+def test_resume_off_recomputes(tmp_path, small_raw):
+    from cobalt_smart_lender_ai_tpu.pipeline import run_pipeline
+
+    cfg = _tiny_pipeline_config()
+    store = ObjectStore(str(tmp_path / "lake"))
+    run_pipeline(cfg, raw=small_raw, store=store)
+    again = run_pipeline(cfg, raw=small_raw, store=store)  # resume not requested
+    assert again.stages_skipped == ()
+
+
+@pytest.mark.faults
+def test_pipeline_completes_under_injected_faults(tmp_path, small_raw):
+    """ISSUE acceptance: the pipeline against a FaultInjectingStore with
+    transient faults completes via retries (observable retry counter)."""
+    from cobalt_smart_lender_ai_tpu.pipeline import run_pipeline
+
+    cfg = _tiny_pipeline_config(max_attempts=8)
+    inner = ObjectStore(str(tmp_path / "lake"))
+    faulty = FaultInjectingStore(
+        inner,
+        seed=13,
+        faults={
+            "put": FaultSpec(rate=0.15),
+            "get": FaultSpec(rate=0.15),
+            "exists": FaultSpec(rate=0.15),
+        },
+    )
+    result = run_pipeline(cfg, raw=small_raw, store=faulty)
+    assert result.test_auc > 0.85
+    assert sum(faulty.injected.values()) > 0, "faults must actually fire"
+    # artifact round-trips through the still-faulty store via retries
+    resilient = ResilientStore(
+        faulty, RetryPolicy(max_attempts=8, base_delay_s=0.0, jitter=0.0)
+    )
+    assert json.loads(
+        resilient.get_bytes(cfg.serve.model_key + ".metrics.json")
+    )["auc"] == pytest.approx(result.test_auc)
+
+
+# --- serving: degraded SHAP + health over both adapters ----------------------
+
+
+@pytest.fixture()
+def degraded_service(serving_artifact, monkeypatch):
+    """ScorerService whose SHAP program fails to build (forced), configured
+    to degrade rather than die."""
+    import cobalt_smart_lender_ai_tpu.serve.service as service_mod
+
+    def broken_shap(*a, **k):
+        raise RuntimeError("SHAP compile forced to fail")
+
+    monkeypatch.setattr(service_mod, "shap_values", broken_shap)
+    store, _ = serving_artifact
+    return service_mod.ScorerService.from_store(store)
+
+
+def _contract_payload() -> dict:
+    from cobalt_smart_lender_ai_tpu.data import schema
+    from cobalt_smart_lender_ai_tpu.serve.service import SINGLE_INPUT_FIELDS
+
+    return {
+        field: 1 if canonical in schema.SERVING_INT_FEATURES else 1.5
+        for field, canonical in SINGLE_INPUT_FIELDS.items()
+    }
+
+
+def test_degraded_shap_serves_probability(degraded_service):
+    svc = degraded_service
+    assert svc._shap_fn is None and svc._shap_error
+    resp = svc.predict_single(_contract_payload())
+    assert 0.0 <= resp["prob_default"] <= 1.0
+    assert resp["shap_values"] is None
+    assert resp["base_value"] is None
+    assert resp["degraded"] is True
+    ready, payload = svc.ready()
+    assert ready  # still scorable: degraded SHAP does not fail readiness
+    assert payload["shap"] == "degraded" and payload["degraded"] is True
+    assert "shap_error" in payload
+
+
+def test_degraded_flag_absent_when_healthy(serving_artifact):
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store)
+    resp = svc.predict_single(_contract_payload())
+    # the reference's exact response keys — no degraded flag on healthy paths
+    assert set(resp) == {
+        "prob_default", "shap_values", "base_value", "features", "input_row",
+    }
+    assert len(resp["shap_values"]) == len(svc.feature_names)
+
+
+def test_runtime_shap_failure_degrades(serving_artifact):
+    """Failure at execution time (not compile time) also degrades."""
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store)
+
+    def exec_boom(x):
+        raise RuntimeError("device OOM mid-shap")
+
+    svc._shap_fn = exec_boom
+    resp = svc.predict_single(_contract_payload())
+    assert resp["degraded"] is True and resp["shap_values"] is None
+    assert 0.0 <= resp["prob_default"] <= 1.0
+
+
+def test_degrade_disabled_raises(serving_artifact, monkeypatch):
+    """degrade_shap=False keeps the old fail-fast behavior."""
+    import cobalt_smart_lender_ai_tpu.serve.service as service_mod
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+
+    def broken_shap(*a, **k):
+        raise RuntimeError("SHAP compile forced to fail")
+
+    monkeypatch.setattr(service_mod, "shap_values", broken_shap)
+    store, _ = serving_artifact
+    cfg = ServeConfig(
+        reliability=ReliabilityConfig(degrade_shap=False)
+    )
+    with pytest.raises(RuntimeError, match="forced to fail"):
+        service_mod.ScorerService.from_store(store, cfg)
+
+
+def test_stdlib_adapter_degraded_and_health(degraded_service):
+    """ISSUE acceptance: POST /predict over real HTTP returns 200 with
+    degraded=true and a valid prob_default; /healthz + /readyz respond."""
+    import http.client
+
+    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+
+    httpd = make_server(degraded_service)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        host, port = httpd.server_address[:2]
+
+        def request(method: str, path: str, body: bytes | None = None):
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            headers = {"Content-Type": "application/json"} if body else {}
+            conn.request(method, path, body=body, headers=headers)
+            r = conn.getresponse()
+            data = json.loads(r.read().decode())
+            conn.close()
+            return r.status, data
+
+        status, resp = request(
+            "POST", "/predict", json.dumps(_contract_payload()).encode()
+        )
+        assert status == 200, resp
+        assert resp["degraded"] is True and resp["shap_values"] is None
+        assert 0.0 <= resp["prob_default"] <= 1.0
+
+        status, health = request("GET", "/healthz")
+        assert (status, health) == (200, {"status": "ok"})
+        status, ready = request("GET", "/readyz")
+        assert status == 200  # degraded-but-scorable is still ready
+        assert ready["shap"] == "degraded"
+        assert ready["compiled_batch_buckets"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        thread.join(timeout=5)
+
+
+def test_fastapi_adapter_degraded_and_health(degraded_service, monkeypatch):
+    """The same degraded contract through the FastAPI adapter (stubbed:
+    fastapi is not installed in this image — see test_serve_fastapi_stub)."""
+    import sys
+    import types
+
+    class _HTTPException(Exception):
+        def __init__(self, status_code, detail=""):
+            self.status_code = status_code
+            self.detail = detail
+
+    class _App:
+        def __init__(self, title="", lifespan=None):
+            self.lifespan = lifespan
+            self.posts, self.gets = {}, {}
+
+        def post(self, path):
+            return lambda fn: self.posts.setdefault(path, fn)
+
+        def get(self, path):
+            return lambda fn: self.gets.setdefault(path, fn)
+
+    class _Model:
+        def __init__(self, **kw):
+            self._data = kw
+
+        def __init_subclass__(cls):
+            pass
+
+        def model_dump(self, by_alias=False):
+            return dict(self._data)
+
+    fastapi_mod = types.ModuleType("fastapi")
+    fastapi_mod.FastAPI = _App
+    fastapi_mod.HTTPException = _HTTPException
+    fastapi_mod.UploadFile = object
+    fastapi_mod.File = lambda *a, **k: None
+    pydantic_mod = types.ModuleType("pydantic")
+    pydantic_mod.BaseModel = _Model
+    pydantic_mod.ConfigDict = dict
+    pydantic_mod.Field = lambda alias=None: None
+    monkeypatch.setitem(sys.modules, "fastapi", fastapi_mod)
+    monkeypatch.setitem(sys.modules, "pydantic", pydantic_mod)
+
+    from cobalt_smart_lender_ai_tpu.serve.http_fastapi import create_app
+
+    app = create_app(service=degraded_service)
+    # payload keyed by field names: _Model.model_dump has no aliasing, and
+    # validate_single_input accepts field names directly
+    resp = app.posts["/predict"](_Model(**_contract_payload()))
+    assert resp["degraded"] is True and resp["shap_values"] is None
+    assert 0.0 <= resp["prob_default"] <= 1.0
+    assert app.gets["/healthz"]() == {"status": "ok"}
+    ready = app.gets["/readyz"]()
+    assert ready["shap"] == "degraded" and ready["degraded"] is True
+
+
+# --- UI client retry ----------------------------------------------------------
+
+
+def test_api_client_retries_connection_errors(monkeypatch):
+    import requests
+
+    from cobalt_smart_lender_ai_tpu.ui.core import ApiClient
+
+    sleeps: list[float] = []
+    attempts = {"n": 0}
+
+    class _Resp:
+        def raise_for_status(self):
+            pass
+
+        def json(self):
+            return {"prob_default": 0.5}
+
+    def flaky_post(url, **kw):
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise requests.exceptions.ConnectionError("refused")
+        return _Resp()
+
+    monkeypatch.setattr(requests, "post", flaky_post)
+    client = ApiClient(
+        "http://127.0.0.1:9", retries=3, backoff_s=0.2, sleep=sleeps.append
+    )
+    assert client.predict({"loan_amnt": 1.0}) == {"prob_default": 0.5}
+    assert attempts["n"] == 3
+    assert sleeps == [0.2, 0.4]  # exponential backoff between attempts
+
+
+def test_api_client_exhausts_and_raises(monkeypatch):
+    import requests
+
+    from cobalt_smart_lender_ai_tpu.ui.core import ApiClient
+
+    attempts = {"n": 0}
+
+    def always_down(url, **kw):
+        attempts["n"] += 1
+        raise requests.exceptions.ConnectionError("refused")
+
+    monkeypatch.setattr(requests, "post", always_down)
+    client = ApiClient("http://127.0.0.1:9", retries=3, sleep=lambda s: None)
+    with pytest.raises(requests.exceptions.ConnectionError):
+        client.predict({})
+    assert attempts["n"] == 3
+
+
+def test_api_client_does_not_retry_http_errors(monkeypatch):
+    import requests
+
+    from cobalt_smart_lender_ai_tpu.ui.core import ApiClient
+
+    attempts = {"n": 0}
+
+    class _Resp422:
+        def raise_for_status(self):
+            raise requests.exceptions.HTTPError("422 Unprocessable")
+
+        def json(self):  # pragma: no cover
+            return {}
+
+    def post(url, **kw):
+        attempts["n"] += 1
+        return _Resp422()
+
+    monkeypatch.setattr(requests, "post", post)
+    client = ApiClient("http://127.0.0.1:9", retries=3, sleep=lambda s: None)
+    with pytest.raises(requests.exceptions.HTTPError):
+        client.predict({})
+    assert attempts["n"] == 1  # an HTTP answer is an answer, not a flake

@@ -121,11 +121,19 @@ class _FastAPI:
     def __init__(self, title="", lifespan=None):
         self.title = title
         self.lifespan = lifespan
-        self.routes: dict[str, object] = {}
+        self.routes: dict[str, object] = {}  # POST routes (historical name)
+        self.get_routes: dict[str, object] = {}
 
     def post(self, path):
         def deco(fn):
             self.routes[path] = fn
+            return fn
+
+        return deco
+
+    def get(self, path):
+        def deco(fn):
+            self.get_routes[path] = fn
             return fn
 
         return deco
@@ -175,6 +183,12 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
         "/predict_bulk_csv",
         "/feature_importance_bulk",
     }
+    assert set(app.get_routes) == {"/healthz", "/readyz"}
+
+    # health/readiness GET routes: healthy service -> ok, shap ok, 200 path
+    assert app.get_routes["/healthz"]() == {"status": "ok"}
+    ready_payload = app.get_routes["/readyz"]()
+    assert ready_payload["shap"] == "ok" and not ready_payload["degraded"]
 
     # /predict happy path: the handler only needs model_dump(by_alias=True),
     # so a stand-in with the contract's two aliases drives it; the REAL
