@@ -230,6 +230,32 @@ class ReliabilityConfig:
     #: flag instead of returning HTTP 500.
     degrade_shap: bool = True
 
+    # -- request-path hardening (serving; consumed by reliability/deadline,
+    # -- reliability/admission and reliability/breaker) ------------------------
+    #: Per-request wall-clock budget. The service checks it at cooperative
+    #: cancellation checkpoints (after validation, between batch chunks,
+    #: before SHAP) and raises ``DeadlineExceeded`` (HTTP 504) when spent.
+    #: ``None`` disables deadlines.
+    request_deadline_s: float | None = 30.0
+    #: Token-bucket admission rate for scoring requests (requests/second,
+    #: sustained). ``None`` disables rate limiting.
+    rate_limit_rps: float | None = None
+    #: Burst capacity of the admission token bucket.
+    rate_limit_burst: int = 16
+    #: Hard cap on concurrently-executing scoring requests; excess load is
+    #: shed as HTTP 429 with ``Retry-After`` instead of queueing unboundedly.
+    #: ``None`` disables the cap.
+    max_in_flight: int | None = 64
+    #: ``Retry-After`` hint (seconds) for requests shed at the in-flight cap
+    #: (the rate limiter computes its own from the bucket deficit).
+    shed_retry_after_s: float = 1.0
+    #: Circuit breaker over store-backed serving operations (startup restore,
+    #: hot reload): consecutive failures to trip open, seconds until a
+    #: half-open probe, and how many probes may fly at once.
+    breaker_failure_threshold: int = 5
+    breaker_reset_s: float = 30.0
+    breaker_half_open_max: int = 1
+
 
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:
@@ -246,6 +272,12 @@ class ServeConfig:
     #: backend). ``precompile_batch_buckets`` are warmed at startup.
     max_batch_rows: int = 4096
     precompile_batch_buckets: tuple[int, ...] = (256,)
+    #: Bulk-CSV request bounds: payloads over either limit are rejected with
+    #: a typed ``PayloadTooLarge`` (HTTP 413) *before* parse/score — an
+    #: unbounded CSV can OOM the host or trigger a fresh multi-second XLA
+    #: compile for an arbitrary batch bucket. ``None`` disables a bound.
+    max_bulk_rows: int | None = 100_000
+    max_bulk_bytes: int | None = 16 * 1024 * 1024
     reliability: ReliabilityConfig = dataclasses.field(
         default_factory=ReliabilityConfig
     )

@@ -3,7 +3,9 @@
 The reference dies on the first transient failure anywhere: an S3 read that
 times out kills a preprocessing script, a crash mid-search throws away hours
 of RFE work, and a SHAP failure at serve time 500s the request. This package
-provides the four primitives the rest of the framework wires in:
+provides the primitives the rest of the framework wires in:
+
+Storage-side (PR 2):
 
 - `retry` — `RetryPolicy` (bounded attempts, exponential backoff + jitter,
   deadline, retryable-exception predicate) and `call_with_retry`, with the
@@ -13,18 +15,55 @@ provides the four primitives the rest of the framework wires in:
   `<key>.ptr.json` pointers on read (a corrupted read is retried, not
   silently consumed).
 - `faults` — `FaultInjectingStore`, a seeded, deterministic test double that
-  injects failure-rate / fail-after-N / corrupted-bytes faults per
+  injects failure-rate / fail-after-N / corrupted-bytes / latency faults per
   operation, so every resilience claim in the test suite is exercised under
   real (injected) faults instead of asserted.
 - `checkpoint` — `PipelineCheckpoint`: per-stage manifests (outputs, md5+size
   pointers, config fingerprint) that `pipeline.run_pipeline` writes after
   each stage and its `--resume` path validates to skip stages whose outputs
   still verify.
+
+Request-path hardening (PR 3 — the classic SRE stability patterns):
+
+- `errors` — the one serving error taxonomy (`RequestError` + typed
+  subclasses with HTTP status, stable code, `Retry-After`) both HTTP
+  adapters map identically via `error_response`.
+- `deadline` — `Deadline` / `start_deadline`: per-request wall-clock budgets
+  with cooperative cancellation checkpoints (`DeadlineExceeded` → 504).
+- `admission` — `TokenBucket` + `AdmissionController`: rate limiting and a
+  bounded in-flight cap that shed overload as `RequestShed` (429 +
+  ``Retry-After``) instead of queueing unboundedly.
+- `breaker` — `CircuitBreaker` (closed/open/half-open, injectable clock)
+  wrapping store-backed serving operations so a flapping store fails fast
+  (`CircuitOpenError` → 503) instead of tying up workers in retries.
 """
 
+from cobalt_smart_lender_ai_tpu.reliability.admission import (
+    AdmissionController,
+    TokenBucket,
+    admission_from_config,
+)
+from cobalt_smart_lender_ai_tpu.reliability.breaker import (
+    CircuitBreaker,
+    breaker_from_config,
+)
 from cobalt_smart_lender_ai_tpu.reliability.checkpoint import (
     PipelineCheckpoint,
     config_fingerprint,
+)
+from cobalt_smart_lender_ai_tpu.reliability.deadline import (
+    Deadline,
+    start_deadline,
+)
+from cobalt_smart_lender_ai_tpu.reliability.errors import (
+    CircuitOpenError,
+    DeadlineExceeded,
+    PayloadTooLarge,
+    ReloadFailed,
+    RequestError,
+    RequestShed,
+    ValidationError,
+    error_response,
 )
 from cobalt_smart_lender_ai_tpu.reliability.faults import (
     FaultInjectingStore,
@@ -43,15 +82,30 @@ from cobalt_smart_lender_ai_tpu.reliability.stores import (
 )
 
 __all__ = [
+    "AdmissionController",
+    "CircuitBreaker",
+    "CircuitOpenError",
     "CorruptObjectError",
+    "Deadline",
+    "DeadlineExceeded",
     "FaultInjectingStore",
     "FaultSpec",
     "InjectedFault",
+    "PayloadTooLarge",
     "PipelineCheckpoint",
+    "ReloadFailed",
+    "RequestError",
+    "RequestShed",
     "ResilientStore",
     "RetryPolicy",
+    "TokenBucket",
+    "ValidationError",
+    "admission_from_config",
+    "breaker_from_config",
     "call_with_retry",
     "config_fingerprint",
+    "error_response",
     "is_transient_store_error",
     "policy_from_config",
+    "start_deadline",
 ]

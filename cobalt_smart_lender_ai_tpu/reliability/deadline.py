@@ -1,0 +1,60 @@
+"""Per-request deadlines with cooperative cancellation checkpoints.
+
+A JAX dispatch cannot be interrupted mid-flight, so cancellation is
+cooperative: the service calls `Deadline.check` at the points where abandoning
+the request is cheap (after validation, between batch chunks, before the
+optional SHAP program). A tripped checkpoint raises
+`errors.DeadlineExceeded` (HTTP 504) and the worker is freed immediately
+instead of finishing work whose client has already given up.
+
+The clock is injectable (`time.monotonic` by default) so deadline behavior is
+asserted against fake clocks in tier-1 — no test ever sleeps for real.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from cobalt_smart_lender_ai_tpu.reliability.errors import DeadlineExceeded
+
+
+class Deadline:
+    """An absolute expiry point on an injectable monotonic clock."""
+
+    __slots__ = ("budget_s", "_expires_at", "_clock")
+
+    def __init__(
+        self, budget_s: float, clock: Callable[[], float] = time.monotonic
+    ):
+        if budget_s < 0:
+            raise ValueError(f"deadline budget must be >= 0, got {budget_s}")
+        self.budget_s = float(budget_s)
+        self._clock = clock
+        self._expires_at = clock() + float(budget_s)
+
+    def remaining(self) -> float:
+        """Seconds left; negative once expired."""
+        return self._expires_at - self._clock()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, checkpoint: str = "request") -> None:
+        """Cooperative cancellation point: raise `DeadlineExceeded` if the
+        budget is spent. ``checkpoint`` names where the request died so 504
+        bodies say what was abandoned, not just that something was."""
+        remaining = self.remaining()
+        if remaining <= 0.0:
+            raise DeadlineExceeded(
+                f"deadline of {self.budget_s:g}s exceeded at {checkpoint!r} "
+                f"({-remaining:.3f}s over budget)"
+            )
+
+
+def start_deadline(
+    budget_s: float | None, clock: Callable[[], float] = time.monotonic
+) -> Deadline | None:
+    """Begin a request deadline; ``None`` budget means no deadline (callers
+    guard checkpoints with ``if deadline is not None``)."""
+    return None if budget_s is None else Deadline(budget_s, clock)

@@ -1,0 +1,118 @@
+"""Request-path error taxonomy — one module both HTTP adapters map from.
+
+PR 2 gave the storage side a shared failure vocabulary (`InjectedFault`,
+`CorruptObjectError`); the request path had none: the stdlib and FastAPI
+adapters each grew their own ad-hoc status mapping, and anything unexpected
+collapsed into an untyped HTTP 500. Here every serving failure mode is a
+`RequestError` subclass carrying its HTTP status and a stable machine-readable
+``code``, so
+
+- both adapters translate identically (`error_response` is the whole mapping),
+- clients (`ui.core.ApiClient`) can tell *degraded* states (shed, breaker
+  open, deadline) from real faults without parsing prose, and
+- the chaos soak can assert "zero untyped 500s": any 500 whose body lacks an
+  ``error`` code is a bug escape, not a policy decision.
+
+The taxonomy (see README "Serving guarantees"):
+
+==== ====================== ==================================================
+422  ``invalid_input``      request failed the serving schema
+413  ``payload_too_large``  bulk CSV over ``max_bulk_rows``/``max_bulk_bytes``
+429  ``shed``               admission control refused (rate / in-flight cap);
+                            always carries ``Retry-After``
+503  ``circuit_open``       a store-backed dependency is failing fast;
+                            carries ``Retry-After`` (time until half-open)
+504  ``deadline_exceeded``  cooperative cancellation hit the request deadline
+500  ``reload_failed``      hot model swap failed and was rolled back
+==== ====================== ==================================================
+"""
+
+from __future__ import annotations
+
+import math
+
+
+class RequestError(Exception):
+    """Base of the serving taxonomy: HTTP ``status`` + stable ``code``.
+
+    ``retry_after_s`` (when set) becomes a ``Retry-After`` header so clients
+    pace their retries off the server's own estimate instead of guessing.
+    """
+
+    status: int = 500
+    code: str = "internal"
+
+    def __init__(self, detail: str = "", *, retry_after_s: float | None = None):
+        super().__init__(detail)
+        self.detail = detail or self.code
+        self.retry_after_s = retry_after_s
+
+    def body(self) -> dict:
+        """JSON body: FastAPI's ``detail`` convention + the typed ``code``."""
+        return {"detail": self.detail, "error": self.code}
+
+    def headers(self) -> dict[str, str]:
+        if self.retry_after_s is None:
+            return {}
+        # Ceil to a whole second with a floor of 1: "Retry-After: 0" is an
+        # invitation to hammer-retry in a busy loop.
+        return {"Retry-After": str(max(1, math.ceil(self.retry_after_s)))}
+
+
+class ValidationError(RequestError, ValueError):
+    """Input failed the serving schema; adapters map it to HTTP 422.
+
+    Still a `ValueError` — pre-taxonomy callers catching ValueError keep
+    working (this class moved here from `serve.service`, which re-exports it).
+    """
+
+    status = 422
+    code = "invalid_input"
+
+
+class PayloadTooLarge(RequestError, ValueError):
+    """Bulk request over the configured size bounds — HTTP 413. Rejected
+    *before* parse/score: an unbounded CSV can OOM the host or trigger a
+    fresh multi-second XLA compile for an arbitrary batch bucket."""
+
+    status = 413
+    code = "payload_too_large"
+
+
+class RequestShed(RequestError):
+    """Admission control refused the request (token bucket empty or in-flight
+    cap reached) — HTTP 429 with ``Retry-After``. Shedding is deliberate:
+    bounded rejection beats an unbounded queue collapsing the service."""
+
+    status = 429
+    code = "shed"
+
+
+class CircuitOpenError(RequestError):
+    """A store-backed dependency's circuit breaker is open: fail fast (HTTP
+    503 + ``Retry-After``) instead of tying up a worker in doomed retries."""
+
+    status = 503
+    code = "circuit_open"
+
+
+class DeadlineExceeded(RequestError):
+    """The request's wall-clock budget expired at a cooperative cancellation
+    checkpoint — HTTP 504. Work already paid for is abandoned: past the
+    deadline the client is gone, and a late 200 helps nobody."""
+
+    status = 504
+    code = "deadline_exceeded"
+
+
+class ReloadFailed(RequestError):
+    """Hot model swap failed validation and was rolled back; the previous
+    model keeps serving. Typed 500: operator error, not overload."""
+
+    status = 500
+    code = "reload_failed"
+
+
+def error_response(exc: RequestError) -> tuple[int, dict, dict[str, str]]:
+    """The single adapter-side mapping: (HTTP status, JSON body, headers)."""
+    return exc.status, exc.body(), exc.headers()

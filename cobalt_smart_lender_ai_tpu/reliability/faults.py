@@ -12,8 +12,9 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import time
 from collections import Counter
-from typing import Iterator, Mapping
+from typing import Callable, Iterator, Mapping
 
 from cobalt_smart_lender_ai_tpu.io.store import ObjectStore
 
@@ -34,12 +35,19 @@ class FaultSpec:
       corrupted (first byte flipped) instead of raising.
     - ``max_faults`` — total fault budget for the operation; ``None`` means
       unbounded. A bounded budget guarantees eventual success under retry.
+    - ``delay_s`` / ``delay_jitter_s`` — latency injection: every call (even
+      ones that then fault) sleeps ``delay_s`` plus a seeded uniform draw in
+      ``[0, delay_jitter_s)`` through the store's injectable ``sleep``, so
+      deadline and breaker tests exercise a *slow* store deterministically
+      against a fake clock. Delays do not consume ``max_faults``.
     """
 
     rate: float = 0.0
     fail_after: int | None = None
     corrupt_rate: float = 0.0
     max_faults: int | None = None
+    delay_s: float = 0.0
+    delay_jitter_s: float = 0.0
 
 
 class FaultInjectingStore(ObjectStore):
@@ -47,7 +55,9 @@ class FaultInjectingStore(ObjectStore):
 
     ``faults`` maps operation name (``"put"``, ``"get"``, ``"exists"``,
     ``"delete"``, ``"list"``) to its spec; unlisted operations run clean.
-    ``calls`` / ``injected`` are per-operation counters tests assert against.
+    ``calls`` / ``injected`` / ``delays`` / ``delayed_s`` are per-operation
+    counters tests assert against. ``sleep`` is injectable (default
+    `time.sleep`) so latency injection composes with a fake clock.
     """
 
     OPS = ("put", "get", "exists", "delete", "list")
@@ -61,6 +71,7 @@ class FaultInjectingStore(ObjectStore):
         *,
         seed: int = 0,
         faults: Mapping[str, FaultSpec] | None = None,
+        sleep: Callable[[float], None] = time.sleep,
     ):
         self.inner = inner
         self.uri = inner.uri
@@ -69,18 +80,38 @@ class FaultInjectingStore(ObjectStore):
         if unknown:
             raise ValueError(f"unknown fault ops {sorted(unknown)}; use {self.OPS}")
         self._rng = random.Random(seed)
+        self._sleep = sleep
         self.calls: Counter[str] = Counter()
         self.injected: Counter[str] = Counter()
+        self.delays: Counter[str] = Counter()
+        self.delayed_s: dict[str, float] = {}
 
     # -- fault engine ---------------------------------------------------------
     def _budget_left(self, op: str, spec: FaultSpec) -> bool:
         return spec.max_faults is None or self.injected[op] < spec.max_faults
 
+    def _maybe_delay(self, op: str, spec: FaultSpec) -> None:
+        """Latency injection, before any fault draw: a slow backend is slow
+        whether or not the call then fails. Jitter draws from the shared
+        seeded rng only when configured, so specs without jitter leave the
+        fault-draw sequence of existing seeds untouched."""
+        delay = spec.delay_s
+        if spec.delay_jitter_s:
+            delay += spec.delay_jitter_s * self._rng.random()
+        if delay > 0.0:
+            self.delays[op] += 1
+            self.delayed_s[op] = self.delayed_s.get(op, 0.0) + delay
+            self._sleep(delay)
+
     def _inject(self, op: str) -> None:
-        """Count the call; raise if this call draws a fault."""
+        """Count the call; apply injected latency; raise if this call draws
+        a fault."""
         self.calls[op] += 1
         spec = self.faults.get(op)
-        if spec is None or not self._budget_left(op, spec):
+        if spec is None:
+            return
+        self._maybe_delay(op, spec)
+        if not self._budget_left(op, spec):
             return
         if spec.fail_after is not None and self.calls[op] > spec.fail_after:
             self.injected[op] += 1

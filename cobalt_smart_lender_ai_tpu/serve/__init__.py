@@ -2,16 +2,30 @@
 (`cobalt_fast_api.py`) rebuilt around a TPU-resident pre-compiled scorer.
 
 - `service` — framework-agnostic `ScorerService`: artifact restore, the
-  20-field validation schema (with the two aliased names), and the three
-  endpoint handlers returning reference-shaped JSON.
+  20-field validation schema (with the two aliased names), the three
+  endpoint handlers returning reference-shaped JSON, and the request-path
+  hardening surface: per-request deadlines, admission control, a circuit
+  breaker on store restores, and `reload_from_store` hot model swap with
+  smoke-row validation and rollback.
 - `http_stdlib` — zero-dependency http.server adapter (this image has no
-  fastapi); serves the same routes/status codes.
+  fastapi); serves the same routes/status codes plus ``POST /admin/reload``.
 - `http_fastapi` — FastAPI adapter with the exact pydantic `SingleInput`
   contract, for deployments that have fastapi installed.
+
+Both adapters map failures through the one error taxonomy in
+`reliability.errors` (422 invalid_input / 413 payload_too_large / 429 shed /
+503 circuit_open / 504 deadline_exceeded — README "Serving guarantees").
 
 Entry point: ``python -m cobalt_smart_lender_ai_tpu.serve --store <uri>``.
 """
 
+from cobalt_smart_lender_ai_tpu.reliability.errors import (
+    CircuitOpenError,
+    DeadlineExceeded,
+    PayloadTooLarge,
+    RequestError,
+    RequestShed,
+)
 from cobalt_smart_lender_ai_tpu.serve.service import (
     SINGLE_INPUT_FIELDS,
     ScorerService,
@@ -21,6 +35,11 @@ from cobalt_smart_lender_ai_tpu.serve.service import (
 
 __all__ = [
     "SINGLE_INPUT_FIELDS",
+    "CircuitOpenError",
+    "DeadlineExceeded",
+    "PayloadTooLarge",
+    "RequestError",
+    "RequestShed",
     "ScorerService",
     "ValidationError",
     "validate_single_input",

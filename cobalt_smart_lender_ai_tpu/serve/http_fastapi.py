@@ -4,17 +4,25 @@ reference's `cobalt_fast_api.py`, importable only where fastapi is installed
 
 The pydantic schema reproduces `SingleInput` (cobalt_fast_api.py:59-82)
 including the two aliased field names with spaces and
-population-by-field-name.
+population-by-field-name. Error mapping is shared with the stdlib adapter
+through `reliability.errors.error_response`, so both adapters emit the same
+taxonomy (422/413/429/503/504 with ``Retry-After`` where applicable), and
+both expose the same ``POST /admin/reload`` hot-swap endpoint.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from cobalt_smart_lender_ai_tpu.config import ServeConfig
 from cobalt_smart_lender_ai_tpu.data import schema
 from cobalt_smart_lender_ai_tpu.io import ObjectStore
-from cobalt_smart_lender_ai_tpu.serve.service import ScorerService, ValidationError
+from cobalt_smart_lender_ai_tpu.reliability.errors import (
+    RequestError,
+    ValidationError,
+    error_response,
+)
+from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 
 
 def create_app(service: ScorerService | None = None, store_uri: str | None = None):
@@ -57,6 +65,9 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
     class BulkInput(BaseModel):
         data: List[Dict[str, Any]]
 
+    class ReloadInput(BaseModel):
+        model_key: Optional[str] = None
+
     state: dict[str, ScorerService] = {}
     if service is not None:
         state["service"] = service
@@ -70,21 +81,30 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
 
     app = FastAPI(title="Cobalt TPU Inference API", lifespan=lifespan)
 
+    def _raise_typed(exc: RequestError) -> None:
+        status, body, headers = error_response(exc)
+        raise HTTPException(
+            status_code=status, detail=body["detail"], headers=headers or None
+        )
+
     @app.post("/predict")
     def predict_single(input_data: SingleInput):
         try:
-            return state["service"].predict_single(
-                input_data.model_dump(by_alias=True)
-            )
-        except ValidationError as e:
-            raise HTTPException(status_code=422, detail=str(e))
+            with state["service"].admission.admit():
+                return state["service"].predict_single(
+                    input_data.model_dump(by_alias=True)
+                )
+        except RequestError as e:
+            _raise_typed(e)
 
     @app.post("/predict_bulk_csv")
     async def predict_bulk_csv(file: UploadFile = File(...)):
+        body = await file.read()
         try:
-            return state["service"].predict_bulk_csv(await file.read())
-        except ValidationError as e:
-            raise HTTPException(status_code=422, detail=str(e))
+            with state["service"].admission.admit():
+                return state["service"].predict_bulk_csv(body)
+        except RequestError as e:
+            _raise_typed(e)
         except Exception as e:
             raise HTTPException(
                 status_code=500, detail=f"Bulk prediction failed: {e}"
@@ -93,9 +113,28 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
     @app.post("/feature_importance_bulk")
     def feature_importance_bulk(data: BulkInput):
         try:
-            return state["service"].feature_importance_bulk(data.model_dump())
+            with state["service"].admission.admit():
+                return state["service"].feature_importance_bulk(data.model_dump())
         except ValidationError as e:
+            # this route 400s on empty data in the reference
+            # (cobalt_fast_api.py:131), not 422
             raise HTTPException(status_code=400, detail=str(e))
+        except RequestError as e:
+            _raise_typed(e)
+
+    @app.post("/admin/reload")
+    def admin_reload(data: ReloadInput):
+        # Admin plane: never gated by scoring admission — an operator must be
+        # able to swap in a fixed model while the data plane is shedding.
+        try:
+            result = state["service"].reload_from_store(
+                model_key=data.model_key
+            )
+        except RequestError as e:  # breaker open -> 503 + Retry-After
+            _raise_typed(e)
+        if result["status"] != "ok":
+            raise HTTPException(status_code=500, detail=result)
+        return result
 
     @app.get("/healthz")
     def healthz():

@@ -8,8 +8,13 @@ match the reference:
 - ``POST /predict``                — JSON body, 422 on schema violation
 - ``POST /predict_bulk_csv``      — multipart file upload or raw CSV body
 - ``POST /feature_importance_bulk`` — JSON ``{"data": [...]}``, 400 if empty
+- ``POST /admin/reload``          — hot model swap (optional ``model_key``)
 
-Errors return ``{"detail": ...}`` like FastAPI's HTTPException. The handler
+Errors return ``{"detail": ...}`` like FastAPI's HTTPException, plus a stable
+machine-readable ``"error"`` code from `reliability.errors` — the taxonomy
+both adapters map identically (422/413/429/503/504; see README "Serving
+guarantees"). Scoring routes are gated by `service.admission` (shed → 429
+with ``Retry-After``) and honor the per-request deadline (504). The handler
 is threaded (one TPU dispatch at a time is serialized by JAX itself, so a
 ThreadingHTTPServer is safe).
 """
@@ -21,7 +26,12 @@ import email.policy
 import json
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from cobalt_smart_lender_ai_tpu.serve.service import ScorerService, ValidationError
+from cobalt_smart_lender_ai_tpu.reliability.errors import (
+    RequestError,
+    ValidationError,
+    error_response,
+)
+from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 
 
 def _extract_csv(body: bytes, content_type: str) -> bytes:
@@ -49,11 +59,13 @@ def make_handler(service: ScorerService):
         def log_message(self, fmt, *args):  # noqa: D102
             pass
 
-        def _send(self, code: int, obj) -> None:
+        def _send(self, code: int, obj, headers: dict | None = None) -> None:
             data = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(data)
 
@@ -67,32 +79,76 @@ def make_handler(service: ScorerService):
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)
             try:
+                if self.path == "/admin/reload":
+                    # Admin plane: never gated by scoring admission — an
+                    # operator must be able to swap in a fixed model while the
+                    # data plane is shedding.
+                    self._admin_reload(body)
+                    return
                 if self.path == "/predict":
-                    self._send(200, service.predict_single(self._json_body(body)))
-                elif self.path == "/predict_bulk_csv":
-                    try:
-                        csv_bytes = _extract_csv(
-                            body, self.headers.get("Content-Type", "")
+                    with service.admission.admit():
+                        self._send(
+                            200, service.predict_single(self._json_body(body))
                         )
-                        self._send(200, service.predict_bulk_csv(csv_bytes))
-                    except ValidationError:
-                        raise
-                    except Exception as e:
-                        # parity with the reference's try/except -> HTTP 500
-                        # on the bulk route (cobalt_fast_api.py:124-126)
-                        self._send(500, {"detail": f"Bulk prediction failed: {e}"})
+                elif self.path == "/predict_bulk_csv":
+                    with service.admission.admit():
+                        try:
+                            csv_bytes = _extract_csv(
+                                body, self.headers.get("Content-Type", "")
+                            )
+                            self._send(200, service.predict_bulk_csv(csv_bytes))
+                        except RequestError:
+                            raise  # typed errors keep their status (422/413/504)
+                        except Exception as e:
+                            # parity with the reference's try/except -> HTTP 500
+                            # on the bulk route (cobalt_fast_api.py:124-126)
+                            self._send(
+                                500,
+                                {
+                                    "detail": f"Bulk prediction failed: {e}",
+                                    "error": "bulk_failed",
+                                },
+                            )
                 elif self.path == "/feature_importance_bulk":
-                    payload = self._json_body(body)  # malformed JSON -> 422
-                    try:
-                        self._send(200, service.feature_importance_bulk(payload))
-                    except ValidationError as e:
-                        self._send(400, {"detail": str(e)})
+                    with service.admission.admit():
+                        payload = self._json_body(body)  # malformed JSON -> 422
+                        try:
+                            self._send(
+                                200, service.feature_importance_bulk(payload)
+                            )
+                        except ValidationError as e:
+                            # this route 400s on empty data in the reference
+                            # (cobalt_fast_api.py:131), not 422
+                            self._send(400, e.body())
                 else:
                     self._send(404, {"detail": "Not Found"})
-            except ValidationError as e:
-                self._send(422, {"detail": str(e)})
+            except RequestError as e:
+                self._send(*error_response(e))
             except Exception as e:  # pragma: no cover
-                self._send(500, {"detail": f"Internal server error: {e}"})
+                self._send(
+                    500,
+                    {"detail": f"Internal server error: {e}", "error": "internal"},
+                )
+
+        def _admin_reload(self, body: bytes) -> None:
+            payload = self._json_body(body)
+            if not isinstance(payload, dict):
+                raise ValidationError("body must be a JSON object")
+            result = service.reload_from_store(
+                model_key=payload.get("model_key")
+            )
+            if result["status"] == "ok":
+                self._send(200, result)
+            else:
+                self._send(
+                    500,
+                    {
+                        "detail": f"reload rolled back: {result['error']}",
+                        "error": "reload_failed",
+                        "status": result["status"],
+                        "model_key": result["model_key"],
+                    },
+                )
 
         def do_GET(self):  # noqa: N802
             if self.path == "/healthz":

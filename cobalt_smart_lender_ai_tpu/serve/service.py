@@ -14,6 +14,14 @@ tensors for probabilities, `explain.treeshap.shap_values` for per-row
 attributions. Startup restores the model from the object store exactly like
 the reference's lifespan hook restores its S3 pickle
 (`cobalt_fast_api.py:36-54`).
+
+Request-path hardening (reliability/): every restored model lives in one
+immutable `_CompiledModel` bundle swapped atomically by
+`reload_from_store` (hot swap with smoke-row validation and rollback);
+handlers take cooperative `Deadline` checkpoints (`DeadlineExceeded` → 504);
+bulk requests are bounded (`PayloadTooLarge` → 413); store-backed restores
+run under a `CircuitBreaker`; and the adapters gate scoring routes through
+`ScorerService.admission` (shed → 429 + Retry-After).
 """
 
 from __future__ import annotations
@@ -21,7 +29,8 @@ from __future__ import annotations
 import io as _io
 import math
 import threading
-from typing import Any, Mapping
+import time
+from typing import Any, Callable, Mapping
 
 import jax
 import jax.numpy as jnp
@@ -36,10 +45,29 @@ from cobalt_smart_lender_ai_tpu.models.gbdt import (
     gain_importances,
     predict_margin,
 )
+from cobalt_smart_lender_ai_tpu.reliability.admission import (
+    admission_from_config,
+)
+from cobalt_smart_lender_ai_tpu.reliability.breaker import (
+    CircuitBreaker,
+    breaker_from_config,
+)
+from cobalt_smart_lender_ai_tpu.reliability.deadline import (
+    Deadline,
+    start_deadline,
+)
+from cobalt_smart_lender_ai_tpu.reliability.errors import (
+    DeadlineExceeded,
+    PayloadTooLarge,
+    ValidationError,
+)
 
-
-class ValidationError(ValueError):
-    """Input failed the serving schema; adapters map it to HTTP 422."""
+__all__ = [
+    "SINGLE_INPUT_FIELDS",
+    "ScorerService",
+    "ValidationError",
+    "validate_single_input",
+]
 
 
 #: The serving request schema: every field of the reference's pydantic
@@ -90,21 +118,27 @@ def validate_single_input(payload: Mapping[str, Any]) -> dict[str, float]:
     return row
 
 
-class ScorerService:
-    """Restored model + pre-compiled scorer behind the three endpoints of
-    `cobalt_fast_api.py:96-143`."""
+class _CompiledModel:
+    """One restored artifact plus its pre-compiled device programs — the unit
+    of hot swap.
 
-    def __init__(self, artifact: GBDTArtifact, config: ServeConfig | None = None):
+    Requests read ``service._model`` exactly once (an atomic reference read
+    under the GIL), so a concurrent `reload_from_store` can never hand a
+    request mixed state (new margin program, old feature order). The bundle
+    is built completely off to the side and only published once validated.
+    """
+
+    def __init__(self, artifact: GBDTArtifact, config: ServeConfig):
         self.artifact = artifact
-        self.config = config or ServeConfig()
+        self.config = config
         self.feature_names = list(artifact.feature_names)
-        self._n_features = len(self.feature_names)
+        self.n_features = len(self.feature_names)
         forest = artifact.forest
-        self._forest = forest
+        self.forest = forest
         # Pre-compile both device programs at startup (the reference builds
         # its TreeExplainer in the lifespan hook for the same reason).
-        self._margin_fn = jax.jit(lambda X: predict_margin(forest, X)).lower(
-            jax.ShapeDtypeStruct((1, self._n_features), jnp.float32)
+        self.margin_fn = jax.jit(lambda X: predict_margin(forest, X)).lower(
+            jax.ShapeDtypeStruct((1, self.n_features), jnp.float32)
         ).compile()
         # SHAP is the one *optional* device program: probabilities are the
         # service's contract, attributions are an enrichment. With
@@ -112,18 +146,18 @@ class ScorerService:
         # the service up in degraded mode instead of failing startup — the
         # margin program above has no such net; without a scorer there is
         # nothing to serve.
-        self._shap_fn = None
-        self._shap_error: str | None = None
+        self.shap_fn = None
+        self.shap_error: str | None = None
         try:
-            self._shap_fn = jax.jit(
-                lambda X: shap_values(forest, X, n_features=self._n_features)
+            self.shap_fn = jax.jit(
+                lambda X: shap_values(forest, X, n_features=self.n_features)
             ).lower(
-                jax.ShapeDtypeStruct((1, self._n_features), jnp.float32)
+                jax.ShapeDtypeStruct((1, self.n_features), jnp.float32)
             ).compile()
         except Exception as exc:
-            if not self.config.reliability.degrade_shap:
+            if not config.reliability.degrade_shap:
                 raise
-            self._shap_error = f"{type(exc).__name__}: {exc}"
+            self.shap_error = f"{type(exc).__name__}: {exc}"
         # Batch scoring pads every request to a power-of-two row bucket, so
         # the compile count is bounded by log2(max_batch_rows) over the
         # service's whole lifetime — NOT one XLA compile (tens of seconds on
@@ -131,83 +165,250 @@ class ScorerService:
         # AOT-compiled once and cached; `precompile_batch_buckets` warms the
         # common bulk path at startup alongside the single-row programs.
         self._bucket_lock = threading.Lock()
-        self._bucket_fns: dict[int, Any] = {1: self._margin_fn}  # (1, F) reuse
-        for b in self.config.precompile_batch_buckets:
-            self._margin_for_bucket(self._bucket_of(b))
-        total_gain, _ = gain_importances(forest, self._n_features)
-        self._gain = np.asarray(total_gain)
+        self.bucket_fns: dict[int, Any] = {1: self.margin_fn}  # (1, F) reuse
+        for b in config.precompile_batch_buckets:
+            self.margin_for_bucket(self.bucket_of(b))
+        total_gain, _ = gain_importances(forest, self.n_features)
+        self.gain = np.asarray(total_gain)
 
-    def _bucket_of(self, n: int) -> int:
+    def bucket_of(self, n: int) -> int:
         """Smallest power-of-two >= n, capped at max_batch_rows (larger
         requests are chunked)."""
         return min(1 << max(0, n - 1).bit_length(), self.config.max_batch_rows)
 
-    def _margin_for_bucket(self, bucket: int):
-        fn = self._bucket_fns.get(bucket)
+    def margin_for_bucket(self, bucket: int):
+        fn = self.bucket_fns.get(bucket)
         if fn is None:
             # Lock: the stdlib adapter is a ThreadingHTTPServer; without it,
             # two concurrent first hits on a bucket would each pay the
             # multi-second compile.
             with self._bucket_lock:
-                fn = self._bucket_fns.get(bucket)
+                fn = self.bucket_fns.get(bucket)
                 if fn is None:
-                    forest = self._forest
+                    forest = self.forest
                     fn = (
                         jax.jit(lambda X: predict_margin(forest, X))
                         .lower(
                             jax.ShapeDtypeStruct(
-                                (bucket, self._n_features), jnp.float32
+                                (bucket, self.n_features), jnp.float32
                             )
                         )
                         .compile()
                     )
-                    self._bucket_fns[bucket] = fn
+                    self.bucket_fns[bucket] = fn
         return fn
 
-    @property
-    def compiled_batch_buckets(self) -> tuple[int, ...]:
-        """Row buckets with a live compiled program — observable so tests can
-        assert a second, differently-sized batch does NOT recompile."""
-        return tuple(sorted(self._bucket_fns))
-
-    @classmethod
-    def from_store(
-        cls, store: ObjectStore, config: ServeConfig | None = None
-    ) -> "ScorerService":
-        """Startup restore — the lifespan S3 download + joblib.load of
-        `cobalt_fast_api.py:42-47`."""
-        cfg = config or ServeConfig()
-        return cls(GBDTArtifact.load(store, cfg.model_key), cfg)
-
-    # -- scoring helpers ------------------------------------------------------
-
-    def _row_array(self, row: Mapping[str, float]) -> np.ndarray:
-        x = np.full((1, self._n_features), np.nan, dtype=np.float32)
+    def row_array(self, row: Mapping[str, float]) -> np.ndarray:
+        x = np.full((1, self.n_features), np.nan, dtype=np.float32)
         for i, name in enumerate(self.feature_names):
             if name in row:
                 x[0, i] = row[name]
         return x
 
-    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+    def predict_proba(
+        self, X: np.ndarray, deadline: Deadline | None = None
+    ) -> np.ndarray:
         """P(default) for an (N, F) float array — `predict_proba_df`
         (cobalt_fast_api.py:90-91). Rows are chunked to ``max_batch_rows``
         and each chunk zero-padded to its power-of-two bucket, so any
-        request sequence hits at most log2(max_batch_rows) compiles."""
+        request sequence hits at most log2(max_batch_rows) compiles. The
+        deadline (when given) is checked before each chunk — the cooperative
+        cancellation point of the bulk path."""
         X = np.asarray(X, dtype=np.float32)
         N = X.shape[0]
         out = np.empty((N,), dtype=np.float32)
         step = self.config.max_batch_rows
         for start in range(0, N, step):
+            if deadline is not None:
+                deadline.check(f"bulk scoring, row {start}/{N}")
             chunk = X[start : start + step]
             n = chunk.shape[0]
-            bucket = self._bucket_of(n)
+            bucket = self.bucket_of(n)
             if n < bucket:
                 chunk = np.concatenate(
                     [chunk, np.zeros((bucket - n, X.shape[1]), np.float32)]
                 )
-            margin = self._margin_for_bucket(bucket)(jnp.asarray(chunk))
+            margin = self.margin_for_bucket(bucket)(jnp.asarray(chunk))
             out[start : start + n] = np.asarray(jax.nn.sigmoid(margin))[:n]
         return out
+
+
+class ScorerService:
+    """Restored model + pre-compiled scorer behind the three endpoints of
+    `cobalt_fast_api.py:96-143`, plus the hardening surface: `admission`
+    (adapters gate scoring routes through it), `store_breaker` (guards every
+    store-backed restore), and `reload_from_store` (hot swap/rollback)."""
+
+    def __init__(
+        self,
+        artifact: GBDTArtifact,
+        config: ServeConfig | None = None,
+        *,
+        store: ObjectStore | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        breaker: CircuitBreaker | None = None,
+    ):
+        self.config = config or ServeConfig()
+        self._clock = clock
+        self._store = store
+        self._model_key = self.config.model_key
+        rel = self.config.reliability
+        self.store_breaker = breaker or breaker_from_config(rel, clock=clock)
+        self.admission = admission_from_config(rel, clock=clock)
+        # One reload at a time; request threads never take this lock — they
+        # read `_model` once and run against that snapshot.
+        self._swap_lock = threading.Lock()
+        self._last_reload: dict | None = None
+        self._model = _CompiledModel(artifact, self.config)
+
+    # -- compiled-model delegation (stable public/observed surface) -----------
+
+    @property
+    def artifact(self) -> GBDTArtifact:
+        return self._model.artifact
+
+    @property
+    def feature_names(self) -> list[str]:
+        return self._model.feature_names
+
+    @property
+    def _n_features(self) -> int:
+        return self._model.n_features
+
+    @property
+    def _margin_fn(self):
+        return self._model.margin_fn
+
+    @property
+    def _gain(self) -> np.ndarray:
+        return self._model.gain
+
+    @property
+    def _shap_fn(self):
+        return self._model.shap_fn
+
+    @_shap_fn.setter
+    def _shap_fn(self, fn) -> None:  # tests inject broken SHAP programs
+        self._model.shap_fn = fn
+
+    @property
+    def _shap_error(self) -> str | None:
+        return self._model.shap_error
+
+    @_shap_error.setter
+    def _shap_error(self, err: str | None) -> None:
+        self._model.shap_error = err
+
+    @property
+    def compiled_batch_buckets(self) -> tuple[int, ...]:
+        """Row buckets with a live compiled program — observable so tests can
+        assert a second, differently-sized batch does NOT recompile."""
+        return tuple(sorted(self._model.bucket_fns))
+
+    @classmethod
+    def from_store(
+        cls,
+        store: ObjectStore,
+        config: ServeConfig | None = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> "ScorerService":
+        """Startup restore — the lifespan S3 download + joblib.load of
+        `cobalt_fast_api.py:42-47`, run under the circuit breaker so a dead
+        store fails fast on restart storms. The store handle is kept for
+        `reload_from_store`."""
+        cfg = config or ServeConfig()
+        brk = breaker_from_config(cfg.reliability, clock=clock)
+        artifact = brk.call(lambda: GBDTArtifact.load(store, cfg.model_key))
+        return cls(artifact, cfg, store=store, clock=clock, breaker=brk)
+
+    # -- hot model swap --------------------------------------------------------
+
+    def _smoke_check(self, candidate: _CompiledModel) -> None:
+        """Validate a candidate model before publishing it.
+
+        The pinned smoke row is the all-zeros row: it must score to a finite
+        probability (a poisoned artifact with NaN/inf leaves fails here), and
+        the candidate must keep the current feature contract — a swap must
+        never invalidate the request schema in-flight clients were built
+        against."""
+        current = self._model
+        if tuple(candidate.feature_names) != tuple(current.feature_names):
+            raise ValueError(
+                "feature contract changed: serving "
+                f"{len(current.feature_names)} features, candidate has "
+                f"{len(candidate.feature_names)} "
+                f"(first difference: "
+                f"{sorted(set(candidate.feature_names) ^ set(current.feature_names))[:4]})"
+            )
+        x = np.zeros((1, candidate.n_features), dtype=np.float32)
+        prob = float(jax.nn.sigmoid(candidate.margin_fn(jnp.asarray(x)))[0])
+        if not (math.isfinite(prob) and 0.0 <= prob <= 1.0):
+            raise ValueError(f"smoke row scored {prob!r}, expected [0, 1]")
+
+    def reload_from_store(
+        self,
+        store: ObjectStore | None = None,
+        model_key: str | None = None,
+    ) -> dict:
+        """Hot model swap: restore ``model_key`` (default: the key currently
+        served), compile it off to the side, validate it against the pinned
+        smoke row, and atomically publish it. On any failure the previous
+        model keeps serving (rollback is "don't publish") and the failure is
+        recorded in ``last_reload`` / surfaced via `/readyz`.
+
+        Returns the ``last_reload`` dict: ``{"status": "ok", ...}`` on swap,
+        ``{"status": "rolled_back", "error": ...}`` on failure. The store
+        restore runs under `store_breaker`; an open circuit raises
+        `CircuitOpenError` (HTTP 503) without recording a rollback — the
+        store is known-bad, nothing new was learned."""
+        store = store if store is not None else self._store
+        if store is None:
+            raise RuntimeError(
+                "no store bound: construct the service with from_store() or "
+                "pass store= explicitly"
+            )
+        key = model_key or self._model_key
+        with self._swap_lock:
+            try:
+                artifact = self.store_breaker.call(
+                    lambda: GBDTArtifact.load(store, key)
+                )
+                candidate = _CompiledModel(artifact, self.config)
+                self._smoke_check(candidate)
+            except Exception as exc:
+                from cobalt_smart_lender_ai_tpu.reliability.errors import (
+                    CircuitOpenError,
+                )
+
+                if isinstance(exc, CircuitOpenError):
+                    raise
+                self._last_reload = {
+                    "status": "rolled_back",
+                    "model_key": key,
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+                return self._last_reload
+            self._model = candidate  # the atomic swap
+            self._model_key = key
+            self._last_reload = {
+                "status": "ok",
+                "model_key": key,
+                "n_features": candidate.n_features,
+            }
+            return self._last_reload
+
+    # -- scoring helpers ------------------------------------------------------
+
+    def _new_deadline(self) -> Deadline | None:
+        return start_deadline(
+            self.config.reliability.request_deadline_s, self._clock
+        )
+
+    def predict_proba(
+        self, X: np.ndarray, deadline: Deadline | None = None
+    ) -> np.ndarray:
+        return self._model.predict_proba(X, deadline)
 
     # -- health / readiness ---------------------------------------------------
 
@@ -222,32 +423,44 @@ class ScorerService:
 
         Ready iff the margin program is compiled (it always is once __init__
         returns). A degraded SHAP program does NOT fail readiness — the
-        instance still serves its probability contract — but it is reported
-        so orchestrators and dashboards can see the degradation."""
-        ready = self._margin_fn is not None
+        instance still serves its probability contract — but it is reported,
+        as are the breaker state, admission counters and the outcome of the
+        last hot reload, so orchestrators and dashboards see degradation."""
+        model = self._model
+        ready = model.margin_fn is not None
         payload = {
             "status": "ok" if ready else "unavailable",
-            "model_key": self.config.model_key,
-            "n_features": self._n_features,
+            "model_key": self._model_key,
+            "n_features": model.n_features,
             "compiled_batch_buckets": list(self.compiled_batch_buckets),
-            "shap": "ok" if self._shap_fn is not None else "degraded",
-            "degraded": self._shap_fn is None,
+            "shap": "ok" if model.shap_fn is not None else "degraded",
+            "degraded": model.shap_fn is None,
+            "breaker": self.store_breaker.state,
+            "admission": self.admission.stats(),
         }
-        if self._shap_error is not None:
-            payload["shap_error"] = self._shap_error
+        if model.shap_error is not None:
+            payload["shap_error"] = model.shap_error
+        if self._last_reload is not None:
+            payload["last_reload"] = self._last_reload
         return ready, payload
 
     # -- endpoint handlers ----------------------------------------------------
 
-    def predict_single(self, payload: Mapping[str, Any]) -> dict:
+    def predict_single(
+        self, payload: Mapping[str, Any], *, deadline: Deadline | None = None
+    ) -> dict:
         """`POST /predict` (cobalt_fast_api.py:96-108): probability + per-row
         SHAP in the exact response shape."""
+        dl = deadline if deadline is not None else self._new_deadline()
+        model = self._model
         row = validate_single_input(payload)
-        x = self._row_array(row)
-        margin = self._margin_fn(jnp.asarray(x))
+        if dl is not None:
+            dl.check("input validated")
+        x = model.row_array(row)
+        margin = model.margin_fn(jnp.asarray(x))
         resp = {
             "prob_default": float(jax.nn.sigmoid(margin)[0]),
-            "features": list(self.feature_names),
+            "features": list(model.feature_names),
             # Echo of the validated request (the reference echoes its input
             # df row). Keyed by the schema's canonical names, which equal the
             # model features for the deployed 20-feature contract.
@@ -260,26 +473,38 @@ class ScorerService:
         # the reference's exact key set (no flag), which existing clients
         # assert on.
         try:
-            if self._shap_fn is None:
-                raise RuntimeError(self._shap_error or "SHAP program unavailable")
-            phis, base = self._shap_fn(jnp.asarray(x))
+            if dl is not None:
+                dl.check("probability scored")
+            if model.shap_fn is None:
+                raise RuntimeError(model.shap_error or "SHAP program unavailable")
+            phis, base = model.shap_fn(jnp.asarray(x))
             resp["shap_values"] = np.asarray(phis)[0].tolist()
             resp["base_value"] = float(base)
+        except DeadlineExceeded:
+            # Past the deadline the client is gone — a late degraded 200
+            # helps nobody; this is the 504 path, not the degrade path.
+            raise
         except Exception as exc:
             if not self.config.reliability.degrade_shap:
                 raise
-            if self._shap_error is None:
-                self._shap_error = f"{type(exc).__name__}: {exc}"
+            if model.shap_error is None:
+                model.shap_error = f"{type(exc).__name__}: {exc}"
             resp["shap_values"] = None
             resp["base_value"] = None
             resp["degraded"] = True
         return resp
 
-    def predict_bulk_csv(self, csv_bytes: bytes) -> dict:
+    def predict_bulk_csv(
+        self, csv_bytes: bytes, *, deadline: Deadline | None = None
+    ) -> dict:
         """`POST /predict_bulk_csv` (cobalt_fast_api.py:113-126): CSV in,
         records with an appended `prob_default` column out; non-finite values
         serialized as the string "null" exactly like the reference's
         `fillna("null")`.
+
+        Bounded: payloads over ``max_bulk_bytes`` are rejected before the
+        parse, frames over ``max_bulk_rows`` before scoring — both as typed
+        `PayloadTooLarge` (HTTP 413).
 
         Deliberately parses with pandas, not the native reader: the echoed
         passthrough columns must serialize with pandas' dtype inference
@@ -287,13 +512,28 @@ class ScorerService:
         response must not depend on whether the host has a C++ toolchain.
         Serving batches are small; the native reader's win is the
         training-side ingest (`io.store.load_frame`)."""
+        dl = deadline if deadline is not None else self._new_deadline()
+        cfg = self.config
+        if cfg.max_bulk_bytes is not None and len(csv_bytes) > cfg.max_bulk_bytes:
+            raise PayloadTooLarge(
+                f"bulk CSV is {len(csv_bytes)} bytes; the limit is "
+                f"max_bulk_bytes={cfg.max_bulk_bytes}"
+            )
+        model = self._model
         df = pd.read_csv(_io.BytesIO(csv_bytes))
-        missing = [n for n in self.feature_names if n not in df.columns]
+        if cfg.max_bulk_rows is not None and len(df) > cfg.max_bulk_rows:
+            raise PayloadTooLarge(
+                f"bulk CSV has {len(df)} rows; the limit is "
+                f"max_bulk_rows={cfg.max_bulk_rows}"
+            )
+        if dl is not None:
+            dl.check("CSV parsed")
+        missing = [n for n in model.feature_names if n not in df.columns]
         if missing:
             raise ValidationError(f"csv missing feature columns: {missing}")
-        X = df[self.feature_names].to_numpy(dtype=np.float32, na_value=np.nan)
+        X = df[model.feature_names].to_numpy(dtype=np.float32, na_value=np.nan)
         df = df.copy()
-        df["prob_default"] = self.predict_proba(X)
+        df["prob_default"] = model.predict_proba(X, deadline=dl)
         df = df.replace([np.inf, -np.inf], np.nan)
         records = df.to_dict(orient="records")
         for rec in records:
@@ -302,17 +542,26 @@ class ScorerService:
                     rec[k] = "null"
         return {"predictions": records}
 
-    def feature_importance_bulk(self, payload: Mapping[str, Any]) -> dict:
+    def feature_importance_bulk(
+        self, payload: Mapping[str, Any], *, deadline: Deadline | None = None
+    ) -> dict:
         """`POST /feature_importance_bulk` (cobalt_fast_api.py:128-143):
         top-10 gain importances. Like the reference, the scores are static
         booster gains — the posted rows are only checked for presence."""
+        dl = deadline if deadline is not None else self._new_deadline()
         if not isinstance(payload, Mapping) or not payload.get("data"):
             raise ValidationError("No data provided.")
-        order = np.argsort(-self._gain)[:10]
+        if dl is not None:
+            dl.check("input validated")
+        model = self._model
+        order = np.argsort(-model.gain)[:10]
         return {
             "top_features": [
-                {"feature": self.feature_names[i], "importance": float(self._gain[i])}
+                {
+                    "feature": model.feature_names[i],
+                    "importance": float(model.gain[i]),
+                }
                 for i in order
-                if self._gain[i] > 0
+                if model.gain[i] > 0
             ]
         }

@@ -73,6 +73,10 @@ def main() -> None:
                 core.render_waterfall(ax, wf)
                 plt.tight_layout()
                 st.pyplot(fig)
+            except core.ServiceDegraded as e:
+                # Operational backpressure (shed / breaker open / deadline),
+                # not a user mistake — warn, don't stack-trace.
+                st.warning(str(e))
             except Exception as e:
                 st.error(f"Error during prediction: {e}")
 
@@ -101,6 +105,9 @@ def main() -> None:
                 st.session_state["bulk_results"] = client.predict_bulk_csv(
                     uploaded.name, uploaded.getvalue()
                 )
+            except core.ServiceDegraded as e:
+                st.session_state.pop("bulk_results", None)
+                st.warning(str(e))
             except Exception as e:
                 st.session_state.pop("bulk_results", None)
                 st.error(f"Prediction failed: {e}")

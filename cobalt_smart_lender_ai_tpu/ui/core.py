@@ -206,6 +206,19 @@ def importance_series(top_features: Sequence[Mapping[str, Any]]) -> pd.Series:
     ).sort_values(ascending=False)
 
 
+class ServiceDegraded(RuntimeError):
+    """The serving tier answered but declined to score right now — shedding
+    load (429), circuit open on its store (503 circuit_open), or past the
+    request deadline (504). These are operational states, not user mistakes;
+    the UI shows them as a friendly "busy, try again" banner instead of a
+    stack trace."""
+
+    def __init__(self, message: str, *, reason: str, retry_after_s=None):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
 class ApiClient:
     """Minimal HTTP client for the three serving endpoints — the `requests`
     calls the reference UI makes (cobalt_streamlit.py:85,140,159), pulled out
@@ -218,32 +231,92 @@ class ApiClient:
         retries: int = 3,
         backoff_s: float = 0.2,
         sleep=None,
+        max_retry_after_s: float = 5.0,
     ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.retries = retries
         self.backoff_s = backoff_s
+        self.max_retry_after_s = max_retry_after_s
         self._sleep = sleep  # injectable for tests; None = time.sleep
+
+    def _retry_after_s(self, r, attempt: int) -> float:
+        """Server-suggested wait from ``Retry-After``, capped so a pessimistic
+        server can't stall the UI; falls back to the client's own backoff."""
+        headers = getattr(r, "headers", None) or {}
+        try:
+            suggested = float(headers.get("Retry-After"))
+        except (TypeError, ValueError):
+            suggested = self.backoff_s * (2**attempt)
+        return min(max(suggested, 0.0), self.max_retry_after_s)
+
+    @staticmethod
+    def _degraded(r) -> ServiceDegraded | None:
+        """Map shed/breaker/deadline statuses to `ServiceDegraded`; any other
+        status is handled by raise_for_status as before."""
+        status = getattr(r, "status_code", None)
+        if status not in (429, 503, 504):
+            return None
+        try:
+            body = r.json()
+        except Exception:
+            body = {}
+        code = body.get("error") if isinstance(body, dict) else None
+        if status == 429:
+            return ServiceDegraded(
+                "The scoring service is at capacity; please retry in a moment.",
+                reason="shed",
+                retry_after_s=(getattr(r, "headers", None) or {}).get(
+                    "Retry-After"
+                ),
+            )
+        if status == 503 and code == "circuit_open":
+            return ServiceDegraded(
+                "The model store is temporarily unavailable; "
+                "the service is backing off. Please retry shortly.",
+                reason="circuit_open",
+                retry_after_s=(getattr(r, "headers", None) or {}).get(
+                    "Retry-After"
+                ),
+            )
+        if status == 504 or code == "deadline_exceeded":
+            return ServiceDegraded(
+                "The request took longer than the serving deadline; "
+                "try a smaller batch or retry.",
+                reason="deadline",
+            )
+        return None
 
     def _post(self, path: str, **kwargs) -> Any:
         import time
 
         import requests
 
-        # Retry ONLY connection-level failures (server restarting, transient
-        # network) with exponential backoff. HTTP error statuses are real
-        # answers — a 422 will not get better by asking again.
+        # Retry connection-level failures (server restarting, transient
+        # network) with exponential backoff, and 429 sheds honoring the
+        # server's Retry-After. Other HTTP error statuses are real answers —
+        # a 422 will not get better by asking again.
         sleep = self._sleep or time.sleep
         for attempt in range(self.retries):
             try:
                 r = requests.post(
                     self.base_url + path, timeout=self.timeout, **kwargs
                 )
-                break
             except requests.exceptions.ConnectionError:
                 if attempt == self.retries - 1:
                     raise
                 sleep(self.backoff_s * (2**attempt))
+                continue
+            if (
+                getattr(r, "status_code", None) == 429
+                and attempt < self.retries - 1
+            ):
+                sleep(self._retry_after_s(r, attempt))
+                continue
+            break
+        degraded = self._degraded(r)
+        if degraded is not None:
+            raise degraded
         r.raise_for_status()
         return r.json()
 

@@ -1,0 +1,901 @@
+"""Request-path hardening: deadlines, admission control, circuit breaker,
+and hot model swap with rollback.
+
+Every behavior is first pinned deterministically against fake clocks (no test
+below sleeps to make time pass — `ManualClock.advance` *is* the passage of
+time), then the HTTP surface is exercised through the stdlib adapter so the
+status codes, bodies and ``Retry-After`` headers of the taxonomy
+(`reliability.errors`) are asserted on the wire. The chaos soak at the bottom
+(marked ``slow`` + ``faults``; run by the CI ``faults`` job and excluded from
+tier-1) drives the real threaded server under injected store faults and
+latency while hot-swapping models concurrently, and asserts the ISSUE's
+headline: zero untyped 500s — every failure a client sees is a policy
+decision with a machine-readable code, not a bug escape.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import itertools
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from cobalt_smart_lender_ai_tpu.config import ReliabilityConfig, ServeConfig
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+from cobalt_smart_lender_ai_tpu.reliability import (
+    AdmissionController,
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceeded,
+    FaultInjectingStore,
+    FaultSpec,
+    InjectedFault,
+    PayloadTooLarge,
+    RequestShed,
+    TokenBucket,
+    start_deadline,
+)
+from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+from cobalt_smart_lender_ai_tpu.serve.service import (
+    SINGLE_INPUT_FIELDS,
+    ScorerService,
+)
+
+# --- clocks -------------------------------------------------------------------
+
+
+class ManualClock:
+    """Time passes only when the test says so."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, s: float) -> None:
+        self.now += s
+
+
+class TickingClock:
+    """Every read advances a fixed tick — simulates wall time elapsing while
+    the service works, without any real sleeping."""
+
+    def __init__(self, tick: float):
+        self.now = 0.0
+        self.tick = tick
+
+    def __call__(self) -> float:
+        t = self.now
+        self.now += self.tick
+        return t
+
+
+# --- helpers ------------------------------------------------------------------
+
+
+def _valid_payload() -> dict:
+    """One schema-complete /predict body, keyed by canonical feature names."""
+    return {
+        canonical: 1 if canonical in schema.SERVING_INT_FEATURES else 1.5
+        for canonical in SINGLE_INPUT_FIELDS.values()
+    }
+
+
+def _request(url: str, data: bytes | None = None, content_type: str = "application/json"):
+    """(status, json body, headers) for GET (data=None) or POST."""
+    req = urllib.request.Request(url, data=data)
+    if data is not None:
+        req.add_header("Content-Type", content_type)
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read().decode()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), dict(e.headers)
+
+
+@contextlib.contextmanager
+def _running(service: ScorerService):
+    httpd = make_server(service)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def _csv_bytes(X: np.ndarray, n: int) -> bytes:
+    df = pd.DataFrame(X[:n], columns=list(schema.SERVING_FEATURES))
+    return df.to_csv(index=False).encode()
+
+
+def _cfg(**rel) -> ServeConfig:
+    return ServeConfig(
+        precompile_batch_buckets=(), reliability=ReliabilityConfig(**rel)
+    )
+
+
+@pytest.fixture()
+def fresh_store(tmp_path, serving_artifact):
+    """Private copy of the trained serving artifact — swap/soak tests write
+    new model versions and poison blobs, which must not leak into the
+    session-scoped store other modules share."""
+    shared, X = serving_artifact
+    art = GBDTArtifact.load(shared, "models/gbdt/model_tree")
+    store = ObjectStore(str(tmp_path / "lake"))
+    art.save(store, "models/gbdt/model_tree")
+    return store, art, X
+
+
+def _zeroed(art: GBDTArtifact) -> GBDTArtifact:
+    """A valid model whose every leaf is 0 — margin 0, P(default) exactly 0.5
+    for any input: a hot swap to it is observable from a single prediction."""
+    return dataclasses.replace(
+        art,
+        forest=dataclasses.replace(
+            art.forest, leaf_value=jnp.zeros_like(art.forest.leaf_value)
+        ),
+    )
+
+
+# --- deadlines ----------------------------------------------------------------
+
+
+def test_deadline_expires_on_fake_clock():
+    clk = ManualClock()
+    dl = Deadline(1.0, clock=clk)
+    dl.check("start")
+    assert not dl.expired()
+    clk.advance(0.5)
+    assert dl.remaining() == pytest.approx(0.5)
+    clk.advance(0.6)
+    assert dl.expired()
+    with pytest.raises(DeadlineExceeded) as ei:
+        dl.check("bulk scoring, row 6/8")
+    assert "bulk scoring, row 6/8" in str(ei.value)
+    assert ei.value.status == 504 and ei.value.code == "deadline_exceeded"
+
+
+def test_start_deadline_none_disables():
+    assert start_deadline(None) is None
+    assert isinstance(start_deadline(1.0, ManualClock()), Deadline)
+
+
+def test_predict_single_deadline_504_shape(serving_artifact):
+    """With a ticking clock, the budget expires between the validation and
+    SHAP checkpoints — and must surface as DeadlineExceeded, NOT be swallowed
+    into a degraded-SHAP 200."""
+    store, _ = serving_artifact
+    clk = TickingClock(tick=0.03)
+    svc = ScorerService.from_store(
+        store, _cfg(request_deadline_s=0.05), clock=clk
+    )
+    with pytest.raises(DeadlineExceeded) as ei:
+        svc.predict_single(_valid_payload())
+    assert "probability scored" in str(ei.value)
+
+
+def test_bulk_deadline_trips_between_chunks(serving_artifact):
+    store, X = serving_artifact
+    clk = TickingClock(tick=0.01)
+    cfg = dataclasses.replace(
+        _cfg(request_deadline_s=0.05), max_batch_rows=2
+    )
+    svc = ScorerService.from_store(store, cfg, clock=clk)
+    with pytest.raises(DeadlineExceeded) as ei:
+        svc.predict_bulk_csv(_csv_bytes(X, 8))
+    assert "bulk scoring, row" in str(ei.value)
+
+
+def test_deadline_maps_to_http_504(serving_artifact):
+    store, _ = serving_artifact
+    clk = TickingClock(tick=0.03)
+    svc = ScorerService.from_store(
+        store, _cfg(request_deadline_s=0.05), clock=clk
+    )
+    with _running(svc) as base:
+        status, body, _ = _request(
+            base + "/predict", json.dumps(_valid_payload()).encode()
+        )
+    assert status == 504
+    assert body["error"] == "deadline_exceeded"
+    assert "deadline" in body["detail"]
+
+
+# --- admission control --------------------------------------------------------
+
+
+def test_token_bucket_fake_clock():
+    clk = ManualClock()
+    tb = TokenBucket(rate_rps=2.0, burst=2, clock=clk)
+    assert tb.try_acquire() and tb.try_acquire()
+    assert not tb.try_acquire()
+    assert tb.retry_after_s() == pytest.approx(0.5)
+    clk.advance(0.5)  # exactly one token refilled
+    assert tb.try_acquire()
+    clk.advance(100.0)  # refill is capped at burst
+    assert tb.try_acquire() and tb.try_acquire()
+    assert not tb.try_acquire()
+
+
+def test_admission_rate_shed_carries_retry_after():
+    clk = ManualClock()
+    adm = AdmissionController(rate_rps=1.0, burst=1, clock=clk)
+    with adm.admit():
+        pass
+    with pytest.raises(RequestShed) as ei:
+        with adm.admit():
+            pass
+    assert ei.value.status == 429 and ei.value.code == "shed"
+    assert ei.value.retry_after_s == pytest.approx(1.0)
+    assert ei.value.headers() == {"Retry-After": "1"}
+    assert adm.stats()["shed_rate"] == 1
+    clk.advance(1.0)
+    with adm.admit():  # token refilled: admitted again
+        pass
+    assert adm.stats()["admitted"] == 2
+
+
+def test_admission_capacity_shed_and_release():
+    adm = AdmissionController(max_in_flight=2, shed_retry_after_s=3.0)
+    slots = [adm.admit() for _ in range(2)]
+    for cm in slots:
+        cm.__enter__()
+    assert adm.stats()["in_flight"] == 2
+    with pytest.raises(RequestShed) as ei:
+        with adm.admit():
+            pass
+    assert ei.value.headers() == {"Retry-After": "3"}
+    for cm in slots:
+        cm.__exit__(None, None, None)
+    with adm.admit():  # slots released: admitted again
+        pass
+    assert adm.stats() == {
+        "in_flight": 0,
+        "admitted": 3,
+        "shed_rate": 0,
+        "shed_capacity": 1,
+    }
+
+
+def test_shed_maps_to_http_429(serving_artifact):
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store, _cfg(max_in_flight=1))
+    body = json.dumps(_valid_payload()).encode()
+    with _running(svc) as base:
+        slot = svc.admission.admit()  # occupy the only slot
+        slot.__enter__()
+        try:
+            status, resp, headers = _request(base + "/predict", body)
+        finally:
+            slot.__exit__(None, None, None)
+        assert status == 429
+        assert resp["error"] == "shed"
+        assert int(headers["Retry-After"]) >= 1
+        # slot released: the same request is admitted and scored
+        status, resp, _ = _request(base + "/predict", body)
+        assert status == 200 and 0.0 <= resp["prob_default"] <= 1.0
+        # shed requests are visible in /readyz admission stats
+        _, ready, _ = _request(base + "/readyz")
+        assert ready["admission"]["shed_capacity"] == 1
+
+
+# --- circuit breaker ----------------------------------------------------------
+
+
+def _boom():
+    raise InjectedFault("store down")
+
+
+def test_breaker_trips_after_consecutive_failures():
+    clk = ManualClock()
+    brk = CircuitBreaker(failure_threshold=3, reset_timeout_s=10.0, clock=clk)
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            brk.call(_boom)
+    assert brk.state == "closed"  # streak below threshold
+    with pytest.raises(InjectedFault):
+        brk.call(_boom)
+    assert brk.state == "open"
+    # open: calls fail fast with the time until half-open, store untouched
+    with pytest.raises(CircuitOpenError) as ei:
+        brk.call(lambda: pytest.fail("must not reach the store"))
+    assert ei.value.status == 503 and ei.value.code == "circuit_open"
+    assert 0.0 < ei.value.retry_after_s <= 10.0
+    assert brk.fast_failures == 1
+    clk.advance(10.0)
+    assert brk.state == "half_open"
+    assert brk.call(lambda: "probe") == "probe"
+    assert brk.state == "closed"
+    assert brk.transitions == ["open", "half_open", "closed"]
+
+
+def test_breaker_success_resets_failure_streak():
+    brk = CircuitBreaker(failure_threshold=3, clock=ManualClock())
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            brk.call(_boom)
+    assert brk.call(lambda: "ok") == "ok"  # resets the streak
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            brk.call(_boom)
+    assert brk.state == "closed"
+    with pytest.raises(InjectedFault):
+        brk.call(_boom)
+    assert brk.state == "open"
+
+
+def test_breaker_failed_probe_reopens_and_restarts_timer():
+    clk = ManualClock()
+    brk = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0, clock=clk)
+    with pytest.raises(InjectedFault):
+        brk.call(_boom)
+    clk.advance(5.0)
+    with pytest.raises(InjectedFault):
+        brk.call(_boom)  # the half-open probe itself fails
+    assert brk.state == "open"
+    clk.advance(4.9)
+    assert brk.state == "open"  # timer restarted by the failed probe
+    clk.advance(0.1)
+    assert brk.call(lambda: "up") == "up"
+    assert brk.transitions == ["open", "half_open", "open", "half_open", "closed"]
+    assert brk.opened_count == 2
+
+
+def test_breaker_half_open_limits_probes():
+    clk = ManualClock()
+    brk = CircuitBreaker(failure_threshold=1, reset_timeout_s=1.0, clock=clk)
+    with pytest.raises(InjectedFault):
+        brk.call(_boom)
+    clk.advance(1.0)
+
+    def probe():
+        # While this probe is in flight, a second caller must be rejected —
+        # half-open admits exactly half_open_max_calls concurrent probes.
+        with pytest.raises(CircuitOpenError):
+            brk.call(lambda: "second")
+        return "first"
+
+    assert brk.call(probe) == "first"
+    assert brk.state == "closed"
+
+
+# --- bounded bulk requests (413) ----------------------------------------------
+
+
+def test_bulk_rows_bound(serving_artifact):
+    store, X = serving_artifact
+    cfg = dataclasses.replace(_cfg(), max_bulk_rows=4)
+    svc = ScorerService.from_store(store, cfg)
+    assert len(svc.predict_bulk_csv(_csv_bytes(X, 4))["predictions"]) == 4
+    with pytest.raises(PayloadTooLarge) as ei:
+        svc.predict_bulk_csv(_csv_bytes(X, 5))
+    assert ei.value.status == 413 and "max_bulk_rows" in str(ei.value)
+
+
+def test_bulk_bytes_bound_rejects_before_parse(serving_artifact):
+    store, _ = serving_artifact
+    cfg = dataclasses.replace(_cfg(), max_bulk_bytes=64)
+    svc = ScorerService.from_store(store, cfg)
+    with pytest.raises(PayloadTooLarge) as ei:
+        svc.predict_bulk_csv(b"x" * 65)  # not even valid CSV: bytes gate first
+    assert "max_bulk_bytes" in str(ei.value)
+
+
+def test_payload_too_large_maps_to_http_413(serving_artifact):
+    store, X = serving_artifact
+    cfg = dataclasses.replace(_cfg(), max_bulk_rows=4)
+    svc = ScorerService.from_store(store, cfg)
+    with _running(svc) as base:
+        status, body, _ = _request(
+            base + "/predict_bulk_csv", _csv_bytes(X, 8), "text/csv"
+        )
+    assert status == 413
+    assert body["error"] == "payload_too_large"
+
+
+# --- hot model swap -----------------------------------------------------------
+
+
+def test_hot_swap_changes_served_model(fresh_store):
+    store, art, _ = fresh_store
+    svc = ScorerService.from_store(store, _cfg())
+    payload = _valid_payload()
+    assert svc.predict_single(payload)["prob_default"] != pytest.approx(0.5)
+    _zeroed(art).save(store, "models/gbdt/v2")
+
+    result = svc.reload_from_store(model_key="models/gbdt/v2")
+    assert result == {
+        "status": "ok",
+        "model_key": "models/gbdt/v2",
+        "n_features": 20,
+    }
+    # the zeroed forest serves margin 0 -> probability exactly 0.5
+    assert svc.predict_single(payload)["prob_default"] == pytest.approx(0.5)
+    ready, payload_r = svc.ready()
+    assert ready
+    assert payload_r["model_key"] == "models/gbdt/v2"
+    assert payload_r["last_reload"]["status"] == "ok"
+
+
+def test_hot_swap_over_http_admin_endpoint(fresh_store):
+    store, art, _ = fresh_store
+    svc = ScorerService.from_store(store, _cfg())
+    _zeroed(art).save(store, "models/gbdt/v2")
+    body = json.dumps(_valid_payload()).encode()
+    with _running(svc) as base:
+        status, resp, _ = _request(
+            base + "/admin/reload",
+            json.dumps({"model_key": "models/gbdt/v2"}).encode(),
+        )
+        assert status == 200 and resp["status"] == "ok"
+        status, pred, _ = _request(base + "/predict", body)
+        assert status == 200
+        assert pred["prob_default"] == pytest.approx(0.5)
+        _, ready, _ = _request(base + "/readyz")
+        assert ready["model_key"] == "models/gbdt/v2"
+
+
+def test_poisoned_artifact_swap_rolls_back(fresh_store):
+    store, _, _ = fresh_store
+    svc = ScorerService.from_store(store, _cfg())
+    payload = _valid_payload()
+    before = svc.predict_single(payload)["prob_default"]
+    store.put_bytes("models/poison.npz", b"\x00this is not an npz archive")
+
+    result = svc.reload_from_store(model_key="models/poison")
+    assert result["status"] == "rolled_back"
+    assert result["model_key"] == "models/poison"
+    assert result["error"]
+    # the previous model is still serving, untouched
+    assert svc.predict_single(payload)["prob_default"] == before
+    _, ready_payload = svc.ready()
+    assert ready_payload["model_key"] == "models/gbdt/model_tree"
+    assert ready_payload["last_reload"]["status"] == "rolled_back"
+
+
+def test_smoke_check_rejects_nonfinite_model(fresh_store):
+    """A loadable artifact whose leaves are NaN scores the pinned smoke row
+    to NaN — validation must reject it before it is published."""
+    store, art, _ = fresh_store
+    svc = ScorerService.from_store(store, _cfg())
+    nan_art = dataclasses.replace(
+        art,
+        forest=dataclasses.replace(
+            art.forest,
+            leaf_value=jnp.full_like(art.forest.leaf_value, jnp.nan),
+        ),
+    )
+    nan_art.save(store, "models/gbdt/nan")
+    result = svc.reload_from_store(model_key="models/gbdt/nan")
+    assert result["status"] == "rolled_back"
+    assert "expected [0, 1]" in result["error"]
+    assert svc._model_key == "models/gbdt/model_tree"
+
+
+def test_smoke_check_rejects_feature_contract_change(fresh_store):
+    store, art, _ = fresh_store
+    svc = ScorerService.from_store(store, _cfg())
+    renamed = dataclasses.replace(
+        art,
+        feature_names=("zzz_not_a_feature",) + tuple(art.feature_names[1:]),
+    )
+    renamed.save(store, "models/gbdt/renamed")
+    result = svc.reload_from_store(model_key="models/gbdt/renamed")
+    assert result["status"] == "rolled_back"
+    assert "feature contract changed" in result["error"]
+
+
+def test_reload_without_store_is_an_error(serving_artifact):
+    store, _ = serving_artifact
+    art = GBDTArtifact.load(store, "models/gbdt/model_tree")
+    svc = ScorerService(art, _cfg())  # constructed without a store handle
+    with pytest.raises(RuntimeError, match="no store bound"):
+        svc.reload_from_store()
+
+
+def test_http_reload_failure_is_typed_500(fresh_store):
+    store, _, _ = fresh_store
+    svc = ScorerService.from_store(store, _cfg())
+    store.put_bytes("models/poison.npz", b"garbage")
+    with _running(svc) as base:
+        status, body, _ = _request(
+            base + "/admin/reload",
+            json.dumps({"model_key": "models/poison"}).encode(),
+        )
+    assert status == 500
+    assert body["error"] == "reload_failed"
+    assert body["status"] == "rolled_back"
+
+
+# --- breaker x reload integration ---------------------------------------------
+
+
+def test_breaker_opens_on_flaky_store_and_recovers(fresh_store):
+    store, _, _ = fresh_store
+    clk = ManualClock()
+    flaky = FaultInjectingStore(store, faults={}, sleep=clk.advance)
+    cfg = _cfg(breaker_failure_threshold=2, breaker_reset_s=5.0)
+    svc = ScorerService.from_store(flaky, cfg, clock=clk)
+
+    flaky.faults["get"] = FaultSpec(fail_after=0)  # store goes hard down
+    assert svc.reload_from_store()["status"] == "rolled_back"
+    assert svc.reload_from_store()["status"] == "rolled_back"
+    assert svc.store_breaker.state == "open"
+    # open circuit: reload fails fast as 503 material, not another rollback
+    with pytest.raises(CircuitOpenError):
+        svc.reload_from_store()
+    _, ready_payload = svc.ready()
+    assert ready_payload["breaker"] == "open"
+    # requests keep serving the in-memory model throughout the outage
+    assert 0.0 <= svc.predict_single(_valid_payload())["prob_default"] <= 1.0
+
+    clk.advance(5.0)  # reset timeout elapses; store comes back
+    del flaky.faults["get"]
+    assert svc.reload_from_store()["status"] == "ok"
+    assert svc.store_breaker.state == "closed"
+    assert svc.store_breaker.transitions == ["open", "half_open", "closed"]
+
+
+# --- latency injection (FaultInjectingStore) ----------------------------------
+
+
+def test_latency_injection_fixed_delay(tmp_path):
+    inner = ObjectStore(str(tmp_path / "lake"))
+    inner.put_bytes("k", b"v")
+    slept: list[float] = []
+    flaky = FaultInjectingStore(
+        inner, faults={"get": FaultSpec(delay_s=0.01)}, sleep=slept.append
+    )
+    assert flaky.get_bytes("k") == b"v"
+    assert flaky.get_bytes("k") == b"v"
+    assert slept == [0.01, 0.01]
+    assert flaky.delays["get"] == 2
+    assert flaky.delayed_s["get"] == pytest.approx(0.02)
+    assert flaky.injected["get"] == 0  # delays are not faults
+
+
+def test_latency_jitter_is_seeded_and_applies_to_faulting_calls(tmp_path):
+    inner = ObjectStore(str(tmp_path / "lake"))
+    inner.put_bytes("k", b"v")
+
+    def build():
+        slept: list[float] = []
+        store = FaultInjectingStore(
+            inner,
+            seed=5,
+            faults={
+                "get": FaultSpec(
+                    fail_after=0, delay_s=0.005, delay_jitter_s=0.01
+                )
+            },
+            sleep=slept.append,
+        )
+        return store, slept
+
+    flaky, slept = build()
+    for _ in range(3):
+        with pytest.raises(InjectedFault):
+            flaky.get_bytes("k")  # the slow store is slow even when it fails
+    assert len(slept) == 3
+    assert all(0.005 <= s < 0.015 for s in slept)
+    assert len(set(slept)) > 1  # jitter actually varies
+    # determinism: same seed, same call sequence -> identical delays
+    flaky2, slept2 = build()
+    for _ in range(3):
+        with pytest.raises(InjectedFault):
+            flaky2.get_bytes("k")
+    assert slept2 == slept
+
+
+def test_ops_without_delay_spec_run_clean(tmp_path):
+    inner = ObjectStore(str(tmp_path / "lake"))
+    slept: list[float] = []
+    flaky = FaultInjectingStore(
+        inner, faults={"get": FaultSpec(delay_s=0.5)}, sleep=slept.append
+    )
+    flaky.put_bytes("k", b"v")  # put has no spec: no delay, no fault
+    assert slept == []
+    assert flaky.get_bytes("k") == b"v"
+    assert slept == [0.5]
+
+
+# --- UI client: Retry-After + degraded states ---------------------------------
+
+
+class _Resp:
+    def __init__(self, status_code, body=None, headers=None):
+        self.status_code = status_code
+        self._body = body or {}
+        self.headers = headers or {}
+
+    def json(self):
+        return self._body
+
+    def raise_for_status(self):
+        if self.status_code >= 400:
+            raise AssertionError(
+                f"{self.status_code} should have been mapped before "
+                "raise_for_status"
+            )
+
+
+def test_api_client_honors_retry_after_on_429(monkeypatch):
+    import requests
+
+    from cobalt_smart_lender_ai_tpu.ui.core import ApiClient
+
+    sleeps: list[float] = []
+    responses = [
+        _Resp(429, {"error": "shed"}, {"Retry-After": "2"}),
+        _Resp(429, {"error": "shed"}, {"Retry-After": "2"}),
+        _Resp(200, {"prob_default": 0.25}),
+    ]
+    monkeypatch.setattr(requests, "post", lambda url, **kw: responses.pop(0))
+    client = ApiClient("http://x", retries=3, backoff_s=0.2, sleep=sleeps.append)
+    assert client.predict({})["prob_default"] == 0.25
+    assert sleeps == [2.0, 2.0]  # the server's pacing, not the client's guess
+
+
+def test_api_client_caps_pessimistic_retry_after(monkeypatch):
+    import requests
+
+    from cobalt_smart_lender_ai_tpu.ui.core import ApiClient
+
+    sleeps: list[float] = []
+    responses = [
+        _Resp(429, {"error": "shed"}, {"Retry-After": "600"}),
+        _Resp(200, {"prob_default": 0.5}),
+    ]
+    monkeypatch.setattr(requests, "post", lambda url, **kw: responses.pop(0))
+    client = ApiClient(
+        "http://x", retries=2, sleep=sleeps.append, max_retry_after_s=5.0
+    )
+    assert client.predict({})["prob_default"] == 0.5
+    assert sleeps == [5.0]
+
+
+@pytest.mark.parametrize(
+    "resp, reason",
+    [
+        (_Resp(429, {"error": "shed"}, {"Retry-After": "1"}), "shed"),
+        (_Resp(503, {"error": "circuit_open", "detail": "x"}), "circuit_open"),
+        (_Resp(504, {"error": "deadline_exceeded", "detail": "x"}), "deadline"),
+    ],
+)
+def test_api_client_surfaces_degraded_states(monkeypatch, resp, reason):
+    import requests
+
+    from cobalt_smart_lender_ai_tpu.ui.core import ApiClient, ServiceDegraded
+
+    attempts = {"n": 0}
+
+    def post(url, **kw):
+        attempts["n"] += 1
+        return resp
+
+    monkeypatch.setattr(requests, "post", post)
+    client = ApiClient("http://x", retries=2, sleep=lambda s: None)
+    with pytest.raises(ServiceDegraded) as ei:
+        client.predict({})
+    assert ei.value.reason == reason
+    # 429 burns the retry budget; breaker-open and deadline answer immediately
+    assert attempts["n"] == (2 if reason == "shed" else 1)
+
+
+def test_api_client_other_503s_stay_http_errors(monkeypatch):
+    """A 503 without the circuit_open code (e.g. /readyz unavailable) is not
+    a degraded state the client should soften — it stays an HTTPError."""
+    import requests
+
+    from cobalt_smart_lender_ai_tpu.ui.core import ApiClient
+
+    class _R503:
+        status_code = 503
+        headers: dict = {}
+
+        def json(self):
+            return {"detail": "not ready"}
+
+        def raise_for_status(self):
+            raise requests.exceptions.HTTPError("503 Service Unavailable")
+
+    monkeypatch.setattr(requests, "post", lambda url, **kw: _R503())
+    client = ApiClient("http://x", retries=2, sleep=lambda s: None)
+    with pytest.raises(requests.exceptions.HTTPError):
+        client.predict({})
+
+
+# --- chaos soak ---------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_chaos_soak_zero_untyped_500s(fresh_store):
+    """Threaded clients hammer every route through the real stdlib server
+    while the store injects faults + latency and an operator hot-swaps
+    between a good model and a poisoned artifact. The soak asserts the
+    hardening contract end to end:
+
+    - every response status is in the taxonomy (no surprise codes),
+    - zero untyped 500s (every 500 body carries a machine-readable code),
+    - every 429 carries Retry-After,
+    - at least one hot swap succeeds and one poisoned swap rolls back
+      *during* the chaos,
+    - the breaker walks open -> half_open -> closed under a forced outage,
+    - and the service still scores cleanly afterwards.
+    """
+    store, art, X = fresh_store
+    _zeroed(art).save(store, "models/gbdt/v2")
+    store.put_bytes("models/poison.npz", b"\x00poisoned artifact bytes")
+
+    flaky = FaultInjectingStore(store, seed=11, faults={})
+    cfg = dataclasses.replace(
+        _cfg(
+            request_deadline_s=10.0,
+            max_in_flight=4,
+            breaker_failure_threshold=3,
+            breaker_reset_s=0.2,
+        ),
+        max_bulk_rows=64,
+    )
+    svc = ScorerService.from_store(flaky, cfg)  # restore before faults start
+    flaky.faults["get"] = FaultSpec(rate=0.4, delay_s=0.002, delay_jitter_s=0.004)
+
+    ok_payload = json.dumps(_valid_payload()).encode()
+    requests_cycle = [
+        ("/predict", ok_payload, "application/json"),
+        ("/predict", b"{}", "application/json"),  # -> 422
+        ("/predict_bulk_csv", _csv_bytes(X, 8), "text/csv"),
+        ("/predict_bulk_csv", _csv_bytes(X, 100), "text/csv"),  # -> 413
+        (
+            "/feature_importance_bulk",
+            json.dumps({"data": [{"a": 1}]}).encode(),
+            "application/json",
+        ),
+        ("/feature_importance_bulk", b'{"data": []}', "application/json"),  # 400
+        ("/readyz", None, ""),
+    ]
+    results: list[tuple[str, int, dict, dict]] = []
+    results_lock = threading.Lock()
+    stop = threading.Event()
+
+    def hammer(offset: int) -> None:
+        i = offset
+        while not stop.is_set():
+            path, data, ct = requests_cycle[i % len(requests_cycle)]
+            i += 1
+            try:
+                status, body, headers = _request(base + path, data, ct)
+            except urllib.error.URLError:
+                continue  # socket-level teardown noise is not what we measure
+            with results_lock:
+                results.append((path, status, body, headers))
+
+    with _running(svc) as base:
+        threads = [
+            threading.Thread(target=hammer, args=(i,), daemon=True)
+            for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+
+        # Operator thread (this one): hot-swap between good and poisoned
+        # artifacts through the flaky store until both outcomes are observed.
+        reload_ok = rolled_back = 0
+        keys = itertools.cycle(
+            ["models/gbdt/v2", "models/poison", "models/gbdt/model_tree"]
+        )
+        give_up = time.monotonic() + 60.0
+        while (reload_ok < 1 or rolled_back < 1) and time.monotonic() < give_up:
+            status, body, _ = _request(
+                base + "/admin/reload",
+                json.dumps({"model_key": next(keys)}).encode(),
+            )
+            if status == 200 and body.get("status") == "ok":
+                reload_ok += 1
+            elif status == 500 and body.get("error") == "reload_failed":
+                rolled_back += 1
+            elif status == 503:  # breaker open: wait out the reset timeout
+                time.sleep(0.25)
+            time.sleep(0.01)
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        # Deterministic shed probe: wait for in-flight stragglers to drain,
+        # fill every admission slot, and the next request must be 429.
+        drain_by = time.monotonic() + 10.0
+        while (
+            svc.admission.stats()["in_flight"] > 0
+            and time.monotonic() < drain_by
+        ):
+            time.sleep(0.02)
+        slots = []
+        for _ in range(4):
+            cm = svc.admission.admit()
+            try:
+                cm.__enter__()
+            except RequestShed:
+                break  # a straggler still holds a slot: cap already reached
+            slots.append(cm)
+        shed_status, shed_body, shed_headers = _request(
+            base + "/predict", ok_payload
+        )
+        for cm in slots:
+            cm.__exit__(None, None, None)
+
+        # Stabilize: faults off, drive reloads until the breaker has closed
+        # and a reload succeeds (the mixed phase may have left it open).
+        del flaky.faults["get"]
+        recover_by = time.monotonic() + 30.0
+        while True:
+            assert time.monotonic() < recover_by, "breaker never re-closed"
+            try:
+                if (
+                    svc.reload_from_store()["status"] == "ok"
+                    and svc.store_breaker.state == "closed"
+                ):
+                    break
+            except CircuitOpenError:
+                pass  # still open: wait out the reset timeout
+            time.sleep(0.05)
+
+        # Forced outage: breaker must walk open -> half_open -> closed.
+        flaky.faults["get"] = FaultSpec(fail_after=0)
+        mark = len(svc.store_breaker.transitions)
+        for _ in range(3):
+            status, body, _ = _request(base + "/admin/reload", b"{}")
+            assert status == 500 and body["error"] == "reload_failed"
+        assert svc.store_breaker.state == "open"
+        status, body, headers = _request(base + "/admin/reload", b"{}")
+        assert status == 503 and body["error"] == "circuit_open"
+        assert "Retry-After" in headers
+        time.sleep(0.25)  # reset timeout (real clock: the server owns it)
+        del flaky.faults["get"]
+        status, body, _ = _request(base + "/admin/reload", b"{}")
+        assert status == 200 and body["status"] == "ok"
+        assert svc.store_breaker.transitions[mark:] == [
+            "open",
+            "half_open",
+            "closed",
+        ]
+
+        # Recovery: chaos over, the service serves cleanly.
+        final_status, final_body, _ = _request(base + "/predict", ok_payload)
+
+    # -- the hardening contract over everything observed -----------------------
+    assert shed_status == 429 and shed_body["error"] == "shed"
+    assert int(shed_headers["Retry-After"]) >= 1
+    assert reload_ok >= 1, "no hot swap succeeded during chaos"
+    assert rolled_back >= 1, "no poisoned swap rolled back during chaos"
+    assert final_status == 200
+    assert 0.0 <= final_body["prob_default"] <= 1.0
+
+    assert len(results) > 50, "soak produced too little traffic to mean much"
+    allowed = {200, 400, 413, 422, 429, 500, 503, 504}
+    for path, status, body, headers in results:
+        assert status in allowed, (path, status, body)
+        if status == 500:
+            # THE headline assertion: a 500 without a typed code is a bug
+            # escape, not a policy decision.
+            assert "error" in body, (path, body)
+        if status == 429:
+            assert "Retry-After" in headers, (path, headers)
+    statuses = {s for _, s, _, _ in results}
+    assert 200 in statuses  # scoring kept working under chaos
+    assert 413 in statuses and 422 in statuses  # typed rejections observed

@@ -82,9 +82,10 @@ def test_fastapi_schema_matches_serving_contract():
 
 
 class _HTTPException(Exception):
-    def __init__(self, status_code, detail=""):
+    def __init__(self, status_code, detail="", headers=None):
         self.status_code = status_code
         self.detail = detail
+        self.headers = headers
 
 
 class _FieldInfo:
@@ -182,6 +183,7 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
         "/predict",
         "/predict_bulk_csv",
         "/feature_importance_bulk",
+        "/admin/reload",
     }
     assert set(app.get_routes) == {"/healthz", "/readyz"}
 
@@ -231,6 +233,19 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
     with pytest.raises(_HTTPException) as ei:
         app.routes["/feature_importance_bulk"](BulkStub(data=[]))
     assert ei.value.status_code == 400
+
+    # /admin/reload: hot swap of the currently-served key succeeds (the
+    # rollback and breaker paths are covered service-level in
+    # test_request_hardening.py; here the route wiring executes).
+    class ReloadStub(_BaseModel):
+        def __getattr__(self, name):
+            try:
+                return self.__dict__["_data"][name]
+            except KeyError:
+                raise AttributeError(name)
+
+    result = app.routes["/admin/reload"](ReloadStub(model_key=None))
+    assert result["status"] == "ok"
 
 
 def test_fastapi_lifespan_restores_from_store(fastapi_stubbed, serving_artifact):
