@@ -1,0 +1,282 @@
+"""Closed-loop serving-throughput benchmark for the micro-batching scheduler.
+
+Trains a small GBDT on the synthetic LendingClub frame in-process (no store,
+no network), then hammers `ScorerService.predict_single` from N closed-loop
+client threads — each client issues its next request the moment the previous
+one resolves, exactly the concurrency shape the micro-batcher coalesces.
+Run with ``--mode both`` to measure batcher-on vs batcher-off on the same
+trained model and emit one JSON line suitable for committing as a
+``BENCH_SERVE_*.json`` record:
+
+    JAX_PLATFORMS=cpu python bench_serve.py --clients 32 --duration-s 5
+
+``--mix mixed`` interleaves bulk-CSV calls (1 in 8) with single-row scoring
+to show the batcher coexisting with large explicit batches; ``--smoke`` is
+the CI profile (4 clients, ~1s) asserting the harness end-to-end without
+burning minutes.
+
+Latency percentiles are computed over single-row requests only (bulk calls
+are reported separately) and the warmup window — which absorbs lazy bucket
+compiles — is excluded from every metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import threading
+import time
+
+
+def _percentile(samples: list[float], q: float) -> float:
+    """Nearest-rank percentile; ``samples`` must be sorted ascending."""
+    if not samples:
+        return float("nan")
+    idx = min(len(samples) - 1, max(0, int(round(q * (len(samples) - 1)))))
+    return samples[idx]
+
+
+def build_service(config, n_rows: int, seed: int = 7):
+    """Train a small serving-contract model and wrap it in a `ScorerService`
+    (the conftest `serving_artifact` recipe, minus the object store)."""
+    import numpy as np
+
+    from cobalt_smart_lender_ai_tpu.data import schema
+    from cobalt_smart_lender_ai_tpu.data.clean import clean_raw_frame
+    from cobalt_smart_lender_ai_tpu.data.features import (
+        engineer_features,
+        prepare_cleaned_frame,
+    )
+    from cobalt_smart_lender_ai_tpu.data.synthetic import (
+        synthetic_lendingclub_frame,
+    )
+    from cobalt_smart_lender_ai_tpu.io import GBDTArtifact
+    from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    raw = synthetic_lendingclub_frame(n_rows=n_rows, seed=seed)
+    cleaned, _ = clean_raw_frame(raw)
+    tree_ff, _, _ = engineer_features(prepare_cleaned_frame(cleaned))
+    ff = tree_ff.select(schema.SERVING_FEATURES)
+    model = GBDTClassifier(n_estimators=25, max_depth=3, n_bins=64)
+    model.fit(np.asarray(ff.X), np.asarray(ff.y))
+    artifact = GBDTArtifact(
+        forest=model.forest,
+        bin_spec=model.bin_spec,
+        feature_names=tuple(schema.SERVING_FEATURES),
+    )
+    return ScorerService(artifact, config), np.array(ff.X)
+
+
+def build_payloads(X, n_payloads: int = 256) -> list[dict]:
+    """Distinct request bodies cycled by the clients, keyed by the aliased
+    wire-format field names the validation schema expects. The tree matrix
+    carries NaN (trees route missing natively) but the single-input schema
+    requires finite values, so NaN becomes 0.0 on the wire."""
+    import math
+
+    from cobalt_smart_lender_ai_tpu.data import schema
+
+    keys = [
+        schema.SERVING_FIELD_ALIASES.get(name, name)
+        for name in schema.SERVING_FEATURES
+    ]
+    payloads = []
+    for i in range(min(n_payloads, X.shape[0])):
+        payloads.append(
+            {
+                k: float(v) if math.isfinite(v) else 0.0
+                for k, v in zip(keys, X[i])
+            }
+        )
+    return payloads
+
+
+def run_load(
+    service,
+    payloads: list[dict],
+    csv_bytes: bytes | None,
+    *,
+    clients: int,
+    duration_s: float,
+    warmup_s: float,
+    mix: str,
+) -> dict:
+    """Drive `clients` closed-loop threads against `service` and return the
+    steady-state (post-warmup) throughput/latency summary."""
+    start_barrier = threading.Barrier(clients + 1)
+    stop_at = [0.0]  # filled in after the barrier releases
+    record_from = [0.0]
+    single_lat: list[list[float]] = [[] for _ in range(clients)]
+    bulk_lat: list[list[float]] = [[] for _ in range(clients)]
+    bulk_rows: list[int] = [0] * clients
+    errors: list[int] = [0] * clients
+
+    def client(idx: int) -> None:
+        start_barrier.wait()
+        i = idx  # offset so clients don't all score the same row
+        while True:
+            now = time.monotonic()
+            if now >= stop_at[0]:
+                return
+            is_bulk = csv_bytes is not None and mix == "mixed" and i % 8 == 7
+            t0 = time.perf_counter()
+            try:
+                if is_bulk:
+                    resp = service.predict_bulk_csv(csv_bytes)
+                    n = len(resp["predictions"])
+                else:
+                    service.predict_single(payloads[i % len(payloads)])
+                    n = 0
+            except Exception:
+                errors[idx] += 1
+                i += 1
+                continue
+            elapsed_ms = (time.perf_counter() - t0) * 1e3
+            if now >= record_from[0]:
+                if is_bulk:
+                    bulk_lat[idx].append(elapsed_ms)
+                    bulk_rows[idx] += n
+                else:
+                    single_lat[idx].append(elapsed_ms)
+            i += 1
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    t_start = time.monotonic()
+    record_from[0] = t_start + warmup_s
+    stop_at[0] = record_from[0] + duration_s
+    start_barrier.wait()
+    for t in threads:
+        t.join()
+
+    singles = sorted(lat for per in single_lat for lat in per)
+    bulks = sorted(lat for per in bulk_lat for lat in per)
+    n_singles, n_bulks = len(singles), len(bulks)
+    result = {
+        "requests": n_singles + n_bulks,
+        "qps": round((n_singles + n_bulks) / duration_s, 1),
+        "single_qps": round(n_singles / duration_s, 1),
+        "errors": sum(errors),
+        "p50_ms": round(_percentile(singles, 0.50), 3),
+        "p95_ms": round(_percentile(singles, 0.95), 3),
+        "p99_ms": round(_percentile(singles, 0.99), 3),
+        "max_ms": round(singles[-1], 3) if singles else float("nan"),
+        "mean_ms": round(statistics.fmean(singles), 3) if singles else float("nan"),
+    }
+    if n_bulks:
+        result["bulk_calls"] = n_bulks
+        result["bulk_rows_per_s"] = round(sum(bulk_rows) / duration_s, 1)
+        result["bulk_p95_ms"] = round(_percentile(bulks, 0.95), 3)
+    if service.batcher is not None:
+        result["microbatch"] = service.batcher.stats()
+    return result
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--clients", type=int, default=32)
+    parser.add_argument("--duration-s", type=float, default=5.0)
+    parser.add_argument("--warmup-s", type=float, default=1.5)
+    parser.add_argument("--rows", type=int, default=2000,
+                        help="synthetic training rows")
+    parser.add_argument("--mix", choices=("single", "mixed"), default="single")
+    parser.add_argument("--mode", choices=("both", "on", "off"), default="both")
+    parser.add_argument("--microbatch-wait-ms", type=float, default=None)
+    parser.add_argument("--microbatch-max-rows", type=int, default=None)
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI profile: 4 clients, ~1s per mode")
+    parser.add_argument("--out", default=None,
+                        help="also write the JSON line to this path")
+    args = parser.parse_args(argv)
+    if args.smoke:
+        args.clients = min(args.clients, 4)
+        args.duration_s = min(args.duration_s, 1.0)
+        args.warmup_s = min(args.warmup_s, 0.5)
+        args.rows = min(args.rows, 800)
+
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+
+    mb_kwargs = {}
+    if args.microbatch_wait_ms is not None:
+        mb_kwargs["microbatch_max_wait_ms"] = args.microbatch_wait_ms
+    if args.microbatch_max_rows is not None:
+        mb_kwargs["microbatch_max_rows"] = args.microbatch_max_rows
+
+    modes = {"both": ("off", "on"), "on": ("on",), "off": ("off",)}[args.mode]
+    results: dict[str, dict] = {}
+    service = None
+    X = None
+    for mode in modes:
+        config = ServeConfig(microbatch_enabled=(mode == "on"), **mb_kwargs)
+        if service is None:
+            print(f"[bench] training model ({args.rows} synthetic rows)...",
+                  file=sys.stderr)
+            service, X = build_service(config, n_rows=args.rows)
+        else:
+            # same trained artifact, fresh compile cache per mode
+            from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+            service = ScorerService(service.artifact, config)
+        payloads = build_payloads(X)
+        csv_bytes = None
+        if args.mix == "mixed":
+            import pandas as pd
+
+            from cobalt_smart_lender_ai_tpu.data import schema
+
+            csv_bytes = (
+                pd.DataFrame(X[:64], columns=list(schema.SERVING_FEATURES))
+                .to_csv(index=False)
+                .encode()
+            )
+        print(
+            f"[bench] batcher_{mode}: {args.clients} clients, "
+            f"{args.duration_s:g}s measured (+{args.warmup_s:g}s warmup)...",
+            file=sys.stderr,
+        )
+        results[f"batcher_{mode}"] = run_load(
+            service,
+            payloads,
+            csv_bytes,
+            clients=args.clients,
+            duration_s=args.duration_s,
+            warmup_s=args.warmup_s,
+            mix=args.mix,
+        )
+        service.close()
+
+    record = {
+        "bench": "serve_throughput",
+        "clients": args.clients,
+        "duration_s": args.duration_s,
+        "mix": args.mix,
+        "platform": _platform_tag(),
+        "results": results,
+    }
+    if "batcher_on" in results and "batcher_off" in results:
+        off, on = results["batcher_off"], results["batcher_on"]
+        if off["qps"] > 0:
+            record["qps_speedup"] = round(on["qps"] / off["qps"], 2)
+    line = json.dumps(record)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    return 0
+
+
+def _platform_tag() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -278,6 +278,21 @@ class ServeConfig:
     #: compile for an arbitrary batch bucket. ``None`` disables a bound.
     max_bulk_rows: int | None = 100_000
     max_bulk_bytes: int | None = 16 * 1024 * 1024
+    #: Micro-batching inference scheduler (serve.service.MicroBatcher):
+    #: concurrent ``predict_single`` callers are coalesced into ONE padded
+    #: bucket dispatch instead of N serialized ``(1, F)`` device round-trips.
+    #: Disable to score every request on its own dispatch (the pre-batcher
+    #: direct path, also what `bench_serve.py --mode off` measures).
+    microbatch_enabled: bool = True
+    #: How long the batcher waits after the first enqueued request for more
+    #: to coalesce before dispatching — the latency the throughput is bought
+    #: with. A request therefore waits at most ``microbatch_max_wait_ms`` +
+    #: one bucket dispatch (plus queueing behind at most one in-flight
+    #: batch). 0 dispatches whatever is queued immediately.
+    microbatch_max_wait_ms: float = 2.0
+    #: Most rows coalesced into one batch; arrivals beyond it dispatch
+    #: immediately. Effectively capped at ``max_batch_rows``.
+    microbatch_max_rows: int = 64
     reliability: ReliabilityConfig = dataclasses.field(
         default_factory=ReliabilityConfig
     )

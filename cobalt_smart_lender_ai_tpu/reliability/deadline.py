@@ -40,16 +40,22 @@ class Deadline:
     def expired(self) -> bool:
         return self.remaining() <= 0.0
 
+    def exceeded(self, checkpoint: str = "request") -> DeadlineExceeded:
+        """Build (without raising) the `DeadlineExceeded` this deadline would
+        raise at ``checkpoint``. The micro-batch scheduler resolves queued
+        requests' futures with it — raising in the batcher thread would tear
+        down the batch, not the one expired request."""
+        return DeadlineExceeded(
+            f"deadline of {self.budget_s:g}s exceeded at {checkpoint!r} "
+            f"({-self.remaining():.3f}s over budget)"
+        )
+
     def check(self, checkpoint: str = "request") -> None:
         """Cooperative cancellation point: raise `DeadlineExceeded` if the
         budget is spent. ``checkpoint`` names where the request died so 504
         bodies say what was abandoned, not just that something was."""
-        remaining = self.remaining()
-        if remaining <= 0.0:
-            raise DeadlineExceeded(
-                f"deadline of {self.budget_s:g}s exceeded at {checkpoint!r} "
-                f"({-remaining:.3f}s over budget)"
-            )
+        if self.remaining() <= 0.0:
+            raise self.exceeded(checkpoint)
 
 
 def start_deadline(

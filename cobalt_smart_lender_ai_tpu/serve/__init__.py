@@ -6,7 +6,9 @@
   endpoint handlers returning reference-shaped JSON, and the request-path
   hardening surface: per-request deadlines, admission control, a circuit
   breaker on store restores, and `reload_from_store` hot model swap with
-  smoke-row validation and rollback.
+  smoke-row validation and rollback. Concurrent single-row requests are
+  coalesced by a `MicroBatcher` into one padded device dispatch per tick
+  (README "Performance"; knobs on `ServeConfig.microbatch_*`).
 - `http_stdlib` — zero-dependency http.server adapter (this image has no
   fastapi); serves the same routes/status codes plus ``POST /admin/reload``.
 - `http_fastapi` — FastAPI adapter with the exact pydantic `SingleInput`
@@ -28,6 +30,7 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
 )
 from cobalt_smart_lender_ai_tpu.serve.service import (
     SINGLE_INPUT_FIELDS,
+    MicroBatcher,
     ScorerService,
     ValidationError,
     validate_single_input,
@@ -37,6 +40,7 @@ __all__ = [
     "SINGLE_INPUT_FIELDS",
     "CircuitOpenError",
     "DeadlineExceeded",
+    "MicroBatcher",
     "PayloadTooLarge",
     "RequestError",
     "RequestShed",

@@ -27,6 +27,25 @@ def main() -> None:
     parser.add_argument("--model-key", default=ServeConfig.model_key)
     parser.add_argument("--host", default=ServeConfig.host)
     parser.add_argument("--port", type=int, default=ServeConfig.port)
+    parser.add_argument(
+        "--no-microbatch",
+        action="store_true",
+        help="dispatch each request individually instead of coalescing "
+        "concurrent requests into one device call",
+    )
+    parser.add_argument(
+        "--microbatch-wait-ms",
+        type=float,
+        default=ServeConfig.microbatch_max_wait_ms,
+        help="coalescing window: worst-case extra latency a request trades "
+        "for throughput",
+    )
+    parser.add_argument(
+        "--microbatch-max-rows",
+        type=int,
+        default=ServeConfig.microbatch_max_rows,
+        help="dispatch early once this many requests are queued",
+    )
     args = parser.parse_args()
 
     # Scorer-bucket compiles persist across service restarts (tens of
@@ -34,10 +53,20 @@ def main() -> None:
     from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
 
     enable_persistent_compile_cache()
-    cfg = ServeConfig(host=args.host, port=args.port, model_key=args.model_key)
+    cfg = ServeConfig(
+        host=args.host,
+        port=args.port,
+        model_key=args.model_key,
+        microbatch_enabled=not args.no_microbatch,
+        microbatch_max_wait_ms=args.microbatch_wait_ms,
+        microbatch_max_rows=args.microbatch_max_rows,
+    )
     service = ScorerService.from_store(ObjectStore(args.store), cfg)
     print(f"[INFO] model restored from {args.store}/{cfg.model_key}; "
           f"{len(service.feature_names)} features")
+    if service.batcher is not None:
+        print(f"[INFO] micro-batching on: wait {cfg.microbatch_max_wait_ms}ms, "
+              f"max {cfg.microbatch_max_rows} rows/dispatch")
 
     try:
         import uvicorn  # noqa: F401

@@ -74,10 +74,15 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
 
     @asynccontextmanager
     async def lifespan(app):
-        if "service" not in state:
+        owns_service = "service" not in state
+        if owns_service:
             uri = store_uri or "artifacts"  # store ROOT; model_key is appended
             state["service"] = ScorerService.from_store(ObjectStore(uri))
         yield
+        if owns_service:
+            # shutdown: drain the micro-batch scheduler (a service passed in
+            # by the caller is the caller's to close)
+            state["service"].close()
 
     app = FastAPI(title="Cobalt TPU Inference API", lifespan=lifespan)
 

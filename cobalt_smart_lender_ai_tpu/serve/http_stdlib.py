@@ -5,7 +5,10 @@ reachable over real HTTP (the reference serves on port 8000,
 `cobalt_fast_api.py:148-149`). Routes, methods, status codes and JSON bodies
 match the reference:
 
-- ``POST /predict``                — JSON body, 422 on schema violation
+- ``POST /predict``                — JSON body, 422 on schema violation;
+  concurrent requests are coalesced into one device dispatch by the
+  service's micro-batcher (the ThreadingHTTPServer's per-request threads
+  are exactly the concurrency it amortizes)
 - ``POST /predict_bulk_csv``      — multipart file upload or raw CSV body
 - ``POST /feature_importance_bulk`` — JSON ``{"data": [...]}``, 400 if empty
 - ``POST /admin/reload``          — hot model swap (optional ``model_key``)
@@ -171,6 +174,9 @@ def serve_forever(service: ScorerService, host: str = "0.0.0.0", port: int = 800
         httpd.serve_forever()
     finally:
         httpd.server_close()
+        # Drain the micro-batch scheduler so queued requests resolve before
+        # the process exits (late arrivals fall back to direct dispatch).
+        service.close()
 
 
 def make_server(

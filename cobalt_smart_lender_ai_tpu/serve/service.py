@@ -22,15 +22,27 @@ handlers take cooperative `Deadline` checkpoints (`DeadlineExceeded` → 504);
 bulk requests are bounded (`PayloadTooLarge` → 413); store-backed restores
 run under a `CircuitBreaker`; and the adapters gate scoring routes through
 `ScorerService.admission` (shed → 429 + Retry-After).
+
+Throughput: concurrent `predict_single` callers are coalesced by a
+`MicroBatcher` — a background scheduler that drains a request queue every
+tick (`microbatch_max_wait_ms` / `microbatch_max_rows`), pads the coalesced
+rows to the existing power-of-two bucket, and runs ONE margin (+ one SHAP)
+dispatch for the whole batch, resolving each caller's future with its own
+row. N concurrent users cost one amortized device round-trip instead of N
+serialized `(1, F)` dispatches with full dispatch overhead each — the
+serving-side analogue of the training stack amortizing histogram passes
+(`bench_serve.py` measures the difference; README "Performance").
 """
 
 from __future__ import annotations
 
+import contextlib
 import io as _io
 import math
 import threading
 import time
-from typing import Any, Callable, Mapping
+from concurrent.futures import Future
+from typing import Any, Callable, Mapping, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +76,7 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
 
 __all__ = [
     "SINGLE_INPUT_FIELDS",
+    "MicroBatcher",
     "ScorerService",
     "ValidationError",
     "validate_single_input",
@@ -133,6 +146,9 @@ class _CompiledModel:
         self.config = config
         self.feature_names = list(artifact.feature_names)
         self.n_features = len(self.feature_names)
+        # name -> column dict built once per model, so request-row assembly
+        # is one hash lookup per key instead of an O(F) scan per request.
+        self._feature_index = {n: i for i, n in enumerate(self.feature_names)}
         forest = artifact.forest
         self.forest = forest
         # Pre-compile both device programs at startup (the reference builds
@@ -166,8 +182,19 @@ class _CompiledModel:
         # common bulk path at startup alongside the single-row programs.
         self._bucket_lock = threading.Lock()
         self.bucket_fns: dict[int, Any] = {1: self.margin_fn}  # (1, F) reuse
+        self.shap_bucket_fns: dict[int, Any] = (
+            {} if self.shap_fn is None else {1: self.shap_fn}
+        )
         for b in config.precompile_batch_buckets:
             self.margin_for_bucket(self.bucket_of(b))
+        # Warm the micro-batcher's coalesced bucket too — margin AND SHAP,
+        # since a coalesced /predict batch dispatches both — so the first
+        # concurrent burst after startup or a hot swap never pays a compile
+        # stall mid-batch. /readyz reports both warmed sets.
+        if config.microbatch_enabled:
+            cap = self.bucket_of(max(1, config.microbatch_max_rows))
+            self.margin_for_bucket(cap)
+            self.shap_for_bucket(cap)
         total_gain, _ = gain_importances(forest, self.n_features)
         self.gain = np.asarray(total_gain)
 
@@ -198,12 +225,57 @@ class _CompiledModel:
                     self.bucket_fns[bucket] = fn
         return fn
 
-    def row_array(self, row: Mapping[str, float]) -> np.ndarray:
-        x = np.full((1, self.n_features), np.nan, dtype=np.float32)
-        for i, name in enumerate(self.feature_names):
-            if name in row:
-                x[0, i] = row[name]
+    def shap_for_bucket(self, bucket: int):
+        """Compiled SHAP program for a padded row bucket, or ``None`` while
+        SHAP is degraded. Same lazy, locked, lifetime-bounded caching as
+        `margin_for_bucket` — without it every coalesced /predict batch
+        would fall back to one ``(1, F)`` SHAP dispatch per row, undoing the
+        batcher's whole point. A failed bucket compile degrades SHAP exactly
+        like the ``(1, F)`` compile at construction (probabilities keep
+        serving) instead of failing the batch."""
+        if self.shap_fn is None:
+            return None
+        fn = self.shap_bucket_fns.get(bucket)
+        if fn is None:
+            with self._bucket_lock:
+                fn = self.shap_bucket_fns.get(bucket)
+                if fn is None:
+                    forest, n = self.forest, self.n_features
+                    try:
+                        fn = (
+                            jax.jit(
+                                lambda X: shap_values(forest, X, n_features=n)
+                            )
+                            .lower(
+                                jax.ShapeDtypeStruct((bucket, n), jnp.float32)
+                            )
+                            .compile()
+                        )
+                    except Exception as exc:
+                        if not self.config.reliability.degrade_shap:
+                            raise
+                        self.shap_error = f"{type(exc).__name__}: {exc}"
+                        self.shap_fn = None
+                        self.shap_bucket_fns = {}
+                        return None
+                    self.shap_bucket_fns[bucket] = fn
+        return fn
+
+    def rows_array(self, rows: Sequence[Mapping[str, float]]) -> np.ndarray:
+        """(len(rows), F) float32 matrix from validated request rows; absent
+        features are NaN (scored as missing). Batch-first so the micro-batch
+        scheduler assembles one coalesced matrix, not N single-row arrays."""
+        x = np.full((len(rows), self.n_features), np.nan, dtype=np.float32)
+        index = self._feature_index
+        for r, row in enumerate(rows):
+            for name, value in row.items():
+                i = index.get(name)
+                if i is not None:
+                    x[r, i] = value
         return x
+
+    def row_array(self, row: Mapping[str, float]) -> np.ndarray:
+        return self.rows_array([row])
 
     def predict_proba(
         self, X: np.ndarray, deadline: Deadline | None = None
@@ -218,6 +290,11 @@ class _CompiledModel:
         N = X.shape[0]
         out = np.empty((N,), dtype=np.float32)
         step = self.config.max_batch_rows
+        # Padding scratch, allocated at most once per call (NOT shared on the
+        # model: predict_proba runs concurrently across request threads) and
+        # reused across chunks instead of np.concatenate building a fresh
+        # padded array per chunk.
+        scratch: np.ndarray | None = None
         for start in range(0, N, step):
             if deadline is not None:
                 deadline.check(f"bulk scoring, row {start}/{N}")
@@ -225,19 +302,243 @@ class _CompiledModel:
             n = chunk.shape[0]
             bucket = self.bucket_of(n)
             if n < bucket:
-                chunk = np.concatenate(
-                    [chunk, np.zeros((bucket - n, X.shape[1]), np.float32)]
-                )
+                if scratch is None or scratch.shape[0] < bucket:
+                    scratch = np.zeros((bucket, X.shape[1]), np.float32)
+                padded = scratch[:bucket]
+                padded[:n] = chunk
+                padded[n:] = 0.0
+                chunk = padded
             margin = self.margin_for_bucket(bucket)(jnp.asarray(chunk))
             out[start : start + n] = np.asarray(jax.nn.sigmoid(margin))[:n]
         return out
+
+
+class MicroBatcher:
+    """Dynamic micro-batching scheduler for the single-row scoring hot path.
+
+    Concurrent `predict_single` callers enqueue their validated row plus a
+    per-request future; this worker drains the queue every tick — it waits
+    ``max_wait_s`` after the first arrival for more requests to coalesce, or
+    dispatches immediately once ``max_rows`` are queued — pads the batch to
+    the existing power-of-two row bucket, runs ONE `margin_for_bucket` (and
+    one `shap_for_bucket`) dispatch, and resolves each future with its own
+    row's result. The coalescing tick runs on the real clock (it is a
+    scheduling knob); request *deadlines* stay on the service's injectable
+    clock and are honored at two points: a request whose deadline expires
+    while queued resolves to `DeadlineExceeded` (HTTP 504) without occupying
+    a batch slot, and one that expires during the un-interruptible dispatch
+    resolves to 504 at resolve time (matching the direct path's
+    post-scoring checkpoint).
+
+    Composition with the hardening surface:
+
+    - admission-shed requests never reach `predict_single`, so they never
+      enqueue — the queue is bounded by ``max_in_flight``;
+    - each batch reads ``service._model`` exactly once, under
+      ``_dispatch_lock``, and `reload_from_store` publishes a new model
+      under the same lock (`pause`) — an in-flight batch drains fully
+      against the `_CompiledModel` it snapshotted and no batch ever mixes
+      models;
+    - a SHAP failure degrades the whole batch's attributions (probabilities
+      still resolve), mirroring the direct path's per-request degrade.
+
+    All counters are observable via `stats()` and surfaced in ``/readyz``.
+    """
+
+    def __init__(
+        self,
+        service: "ScorerService",
+        *,
+        max_wait_s: float,
+        max_rows: int,
+    ):
+        self._service = service
+        self._max_wait_s = max(0.0, float(max_wait_s))
+        self._max_rows = max(1, int(max_rows))
+        self._cond = threading.Condition()
+        self._queue: list[tuple[Mapping[str, float], Deadline | None, Future]] = []
+        # Held for the whole model-snapshot -> dispatch -> resolve span of a
+        # batch; `reload_from_store` publishes under it (see `pause`).
+        self._dispatch_lock = threading.Lock()
+        self._paused = 0
+        self._closed = False
+        self._scratch: np.ndarray | None = None  # worker-only padding buffer
+        self.batches = 0
+        self.coalesced_rows = 0
+        self.max_batch_rows = 0
+        self.expired_in_queue = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="microbatcher"
+        )
+        self._thread.start()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def submit(
+        self, row: Mapping[str, float], deadline: Deadline | None
+    ) -> Future:
+        """Enqueue one validated request row; the returned future resolves to
+        ``(prob, shap_row | None, base_value | None, shap_error | None)`` or
+        raises the request's typed error."""
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("micro-batcher is closed")
+            self._queue.append((row, deadline, fut))
+            self._cond.notify_all()
+        return fut
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    @contextlib.contextmanager
+    def pause(self):
+        """Quiesce the scheduler: requests keep enqueueing but no new batch
+        is collected, and entry waits out the in-flight dispatch (the
+        dispatch lock). `reload_from_store` publishes the new model under
+        this gate so the in-flight batch drains fully against the old model
+        first; tests use it to pin deterministic coalescing. A batch already
+        popped but not yet dispatched simply runs after release — it
+        snapshots its model inside the dispatch lock, so it scores wholly
+        with whichever model is then published (never a mix)."""
+        with self._cond:
+            self._paused += 1
+        try:
+            with self._dispatch_lock:
+                yield
+        finally:
+            with self._cond:
+                self._paused -= 1
+                self._cond.notify_all()
+
+    def close(self) -> None:
+        """Stop the worker after draining already-queued requests."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=10.0)
+
+    def stats(self) -> dict:
+        batches = self.batches
+        return {
+            "batches": batches,
+            "coalesced_rows": self.coalesced_rows,
+            "avg_batch_rows": (
+                round(self.coalesced_rows / batches, 3) if batches else 0.0
+            ),
+            "max_batch_rows": self.max_batch_rows,
+            "expired_in_queue": self.expired_in_queue,
+            "queued": self.queue_depth(),
+        }
+
+    # -- worker ----------------------------------------------------------------
+
+    def _collect(self) -> list | None:
+        """Block for the first arrival, then hold the coalescing window open
+        until ``max_rows`` are queued or ``max_wait_s`` elapses. None means
+        closed-and-drained."""
+        with self._cond:
+            while not self._queue and not self._closed:
+                self._cond.wait()
+            if not self._queue:
+                return None
+            if self._max_wait_s > 0.0 and not self._closed:
+                tick_end = time.monotonic() + self._max_wait_s
+                while len(self._queue) < self._max_rows and not self._closed:
+                    remaining = tick_end - time.monotonic()
+                    if remaining <= 0.0:
+                        break
+                    self._cond.wait(timeout=remaining)
+            while self._paused and not self._closed:
+                self._cond.wait()
+            batch = self._queue[: self._max_rows]
+            del self._queue[: self._max_rows]
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            with self._dispatch_lock:
+                try:
+                    self._dispatch(batch)
+                except BaseException as exc:  # the worker must never die
+                    for _, _, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(exc)
+
+    def _dispatch(self, batch: list) -> None:
+        model = self._service._model  # ONE snapshot: a batch never mixes models
+        live = []
+        for row, dl, fut in batch:
+            if dl is not None and dl.expired():
+                self.expired_in_queue += 1
+                fut.set_exception(dl.exceeded("queued for micro-batch"))
+            else:
+                live.append((row, dl, fut))
+        if not live:
+            return
+        n = len(live)
+        bucket = model.bucket_of(n)
+        scratch = self._scratch
+        if (
+            scratch is None
+            or scratch.shape[0] < bucket
+            or scratch.shape[1] != model.n_features
+        ):
+            scratch = self._scratch = np.zeros(
+                (bucket, model.n_features), np.float32
+            )
+        buf = scratch[:bucket]
+        buf[:n] = model.rows_array([row for row, _, _ in live])
+        buf[n:] = 0.0
+        xb = jnp.asarray(buf)
+        probs = np.asarray(
+            jax.nn.sigmoid(model.margin_for_bucket(bucket)(xb))
+        )[:n]
+        phis = base = None
+        shap_error: str | None = None
+        shap_fn = model.shap_for_bucket(bucket)
+        if shap_fn is None:
+            shap_error = model.shap_error or "SHAP program unavailable"
+        else:
+            try:
+                phis_all, base_v = shap_fn(xb)
+                phis = np.asarray(phis_all)[:n]
+                base = float(base_v)
+            except Exception as exc:
+                shap_error = f"{type(exc).__name__}: {exc}"
+        self.batches += 1
+        self.coalesced_rows += n
+        self.max_batch_rows = max(self.max_batch_rows, n)
+        for i, (_, dl, fut) in enumerate(live):
+            if dl is not None and dl.expired():
+                # The dispatch itself cannot be interrupted; past the
+                # deadline the client is gone — 504, not a late 200 (the
+                # direct path's post-scoring checkpoint).
+                fut.set_exception(dl.exceeded("micro-batch scored"))
+                continue
+            fut.set_result(
+                (
+                    float(probs[i]),
+                    None if phis is None else phis[i].tolist(),
+                    base,
+                    shap_error,
+                )
+            )
 
 
 class ScorerService:
     """Restored model + pre-compiled scorer behind the three endpoints of
     `cobalt_fast_api.py:96-143`, plus the hardening surface: `admission`
     (adapters gate scoring routes through it), `store_breaker` (guards every
-    store-backed restore), and `reload_from_store` (hot swap/rollback)."""
+    store-backed restore), and `reload_from_store` (hot swap/rollback).
+    Concurrent single-row scoring is coalesced by `batcher` (a
+    `MicroBatcher`) when ``ServeConfig.microbatch_enabled``."""
 
     def __init__(
         self,
@@ -260,6 +561,23 @@ class ScorerService:
         self._swap_lock = threading.Lock()
         self._last_reload: dict | None = None
         self._model = _CompiledModel(artifact, self.config)
+        self.batcher: MicroBatcher | None = None
+        if self.config.microbatch_enabled:
+            self.batcher = MicroBatcher(
+                self,
+                max_wait_s=self.config.microbatch_max_wait_ms / 1000.0,
+                max_rows=min(
+                    self.config.microbatch_max_rows,
+                    self.config.max_batch_rows,
+                ),
+            )
+
+    def close(self) -> None:
+        """Stop the micro-batch worker (drains queued requests first);
+        requests arriving afterwards score on the direct per-request path.
+        Idempotent — both HTTP adapters call it at server shutdown."""
+        if self.batcher is not None:
+            self.batcher.close()
 
     # -- compiled-model delegation (stable public/observed surface) -----------
 
@@ -290,6 +608,8 @@ class ScorerService:
     @_shap_fn.setter
     def _shap_fn(self, fn) -> None:  # tests inject broken SHAP programs
         self._model.shap_fn = fn
+        # keep the bucket cache coherent: bucket 1 IS the (1, F) program
+        self._model.shap_bucket_fns = {} if fn is None else {1: fn}
 
     @property
     def _shap_error(self) -> str | None:
@@ -304,6 +624,13 @@ class ScorerService:
         """Row buckets with a live compiled program — observable so tests can
         assert a second, differently-sized batch does NOT recompile."""
         return tuple(sorted(self._model.bucket_fns))
+
+    @property
+    def compiled_shap_buckets(self) -> tuple[int, ...]:
+        """Row buckets with a live compiled SHAP program (empty while SHAP
+        is degraded) — `/readyz` reports it so operators see which coalesced
+        batch sizes are warm before routing a burst at the instance."""
+        return tuple(sorted(self._model.shap_bucket_fns))
 
     @classmethod
     def from_store(
@@ -389,7 +716,18 @@ class ScorerService:
                     "error": f"{type(exc).__name__}: {exc}",
                 }
                 return self._last_reload
-            self._model = candidate  # the atomic swap
+            # Publish under the batcher's dispatch lock: the in-flight batch
+            # (which snapshotted the old _CompiledModel) drains fully before
+            # the reference swap, so no batch ever mixes models; the next
+            # batch snapshots the candidate, whose batch buckets were warmed
+            # at construction above.
+            publish_gate = (
+                self.batcher.pause()
+                if self.batcher is not None
+                else contextlib.nullcontext()
+            )
+            with publish_gate:
+                self._model = candidate  # the atomic swap
             self._model_key = key
             self._last_reload = {
                 "status": "ok",
@@ -433,10 +771,21 @@ class ScorerService:
             "model_key": self._model_key,
             "n_features": model.n_features,
             "compiled_batch_buckets": list(self.compiled_batch_buckets),
+            "compiled_shap_buckets": list(self.compiled_shap_buckets),
             "shap": "ok" if model.shap_fn is not None else "degraded",
             "degraded": model.shap_fn is None,
             "breaker": self.store_breaker.state,
             "admission": self.admission.stats(),
+            "microbatch": (
+                {"enabled": False}
+                if self.batcher is None
+                else {
+                    "enabled": True,
+                    "max_wait_ms": self.config.microbatch_max_wait_ms,
+                    "max_rows": self.config.microbatch_max_rows,
+                    **self.batcher.stats(),
+                }
+            ),
         }
         if model.shap_error is not None:
             payload["shap_error"] = model.shap_error
@@ -450,12 +799,44 @@ class ScorerService:
         self, payload: Mapping[str, Any], *, deadline: Deadline | None = None
     ) -> dict:
         """`POST /predict` (cobalt_fast_api.py:96-108): probability + per-row
-        SHAP in the exact response shape."""
+        SHAP in the exact response shape. With the micro-batcher enabled the
+        request is coalesced with concurrent callers into one padded bucket
+        dispatch; otherwise it scores on its own `(1, F)` programs."""
         dl = deadline if deadline is not None else self._new_deadline()
-        model = self._model
         row = validate_single_input(payload)
         if dl is not None:
             dl.check("input validated")
+        batcher = self.batcher
+        fut = None
+        if batcher is not None and not batcher.closed:
+            try:
+                fut = batcher.submit(row, dl)
+            except RuntimeError:
+                fut = None  # closed in the gap: score on the direct path
+        if fut is not None:
+            # raises the request's typed error (e.g. DeadlineExceeded -> 504)
+            prob, phis_row, base, shap_error = fut.result()
+            model = self._model
+            resp = {
+                "prob_default": prob,
+                "features": list(model.feature_names),
+                "input_row": dict(row),
+            }
+            if phis_row is not None:
+                resp["shap_values"] = phis_row
+                resp["base_value"] = base
+            else:
+                # same degrade contract as the direct path below
+                err = shap_error or "SHAP program unavailable"
+                if not self.config.reliability.degrade_shap:
+                    raise RuntimeError(err)
+                if model.shap_error is None:
+                    model.shap_error = err
+                resp["shap_values"] = None
+                resp["base_value"] = None
+                resp["degraded"] = True
+            return resp
+        model = self._model
         x = model.row_array(row)
         margin = model.margin_fn(jnp.asarray(x))
         resp = {

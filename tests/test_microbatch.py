@@ -1,0 +1,270 @@
+"""Micro-batching scheduler: coalescing, per-request correctness, deadline
+composition, and hot-swap atomicity.
+
+The batcher's coalescing tick runs on the real clock (it is a throughput
+knob, not request policy), so determinism comes from `MicroBatcher.pause`:
+tests quiesce the worker, stack the queue to a known depth, release, and
+assert on the exact batch that forms. Request *deadlines* stay on the
+service's injectable clock, so the queued-expiry 504 is pinned with
+`ManualClock.advance` — no test sleeps to make a deadline pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cobalt_smart_lender_ai_tpu.config import ReliabilityConfig, ServeConfig
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+from cobalt_smart_lender_ai_tpu.reliability import DeadlineExceeded
+from cobalt_smart_lender_ai_tpu.serve.service import (
+    SINGLE_INPUT_FIELDS,
+    ScorerService,
+)
+
+
+class ManualClock:
+    def __init__(self, start: float = 0.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, s: float) -> None:
+        self.now += s
+
+
+def _payload(seed: float = 1.5) -> dict:
+    """Schema-complete /predict body; ``seed`` varies the continuous fields
+    so concurrent requests carry distinct rows."""
+    return {
+        canonical: 1 if canonical in schema.SERVING_INT_FEATURES else seed
+        for canonical in SINGLE_INPUT_FIELDS.values()
+    }
+
+
+def _cfg(max_wait_ms: float = 25.0, max_rows: int = 16, **rel) -> ServeConfig:
+    return ServeConfig(
+        precompile_batch_buckets=(),
+        microbatch_max_wait_ms=max_wait_ms,
+        microbatch_max_rows=max_rows,
+        reliability=ReliabilityConfig(**rel),
+    )
+
+
+def _wait_for(predicate, timeout_s: float = 10.0) -> None:
+    import time
+
+    end = time.monotonic() + timeout_s
+    while not predicate():
+        assert time.monotonic() < end, "condition not reached in time"
+        time.sleep(0.002)
+
+
+# --- coalescing + per-request correctness -------------------------------------
+
+
+def test_concurrent_requests_coalesce_into_one_dispatch(serving_artifact):
+    """N threads scoring distinct rows form exactly ONE batch under a paused
+    scheduler, and every caller gets its own row's probability and SHAP —
+    bit-comparable to the direct (unbatched) path on the same model."""
+    store, _ = serving_artifact
+    n = 16
+    svc = ScorerService.from_store(store, _cfg(max_rows=n))
+    direct = ScorerService.from_store(
+        store, dataclasses.replace(_cfg(), microbatch_enabled=False)
+    )
+    payloads = [_payload(seed=0.25 * i) for i in range(n)]
+    results: list[dict | None] = [None] * n
+
+    def client(i: int) -> None:
+        results[i] = svc.predict_single(payloads[i])
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+    with svc.batcher.pause():
+        for t in threads:
+            t.start()
+        # all n requests are queued behind the paused worker
+        _wait_for(lambda: svc.batcher.queue_depth() == n)
+        assert svc.batcher.batches == 0
+    for t in threads:
+        t.join(timeout=30.0)
+    assert svc.batcher.batches == 1  # ONE device dispatch for all n callers
+    assert svc.batcher.max_batch_rows == n
+    assert svc.batcher.stats()["coalesced_rows"] == n
+
+    for i, resp in enumerate(results):
+        want = direct.predict_single(payloads[i])
+        np.testing.assert_allclose(
+            resp["prob_default"], want["prob_default"], atol=1e-6
+        )
+        np.testing.assert_allclose(
+            resp["shap_values"], want["shap_values"], atol=1e-4
+        )
+        assert resp["input_row"] == want["input_row"]
+        assert set(resp) == set(want)  # exact response-shape parity
+    # distinct rows produced distinct scores (the batch wasn't transposed)
+    probs = {round(r["prob_default"], 9) for r in results}
+    assert len(probs) > 1
+    svc.close()
+    direct.close()
+
+
+def test_queued_deadline_expiry_resolves_504_without_batch_slot(
+    serving_artifact,
+):
+    """A request whose deadline expires while queued gets DeadlineExceeded
+    (HTTP 504) at dispatch time and does NOT occupy a batch slot — the
+    batch that would have carried it never forms when it was the only row."""
+    store, _ = serving_artifact
+    clk = ManualClock()
+    svc = ScorerService.from_store(
+        store, _cfg(request_deadline_s=1.0), clock=clk
+    )
+    caught: list[BaseException] = []
+
+    def client() -> None:
+        try:
+            svc.predict_single(_payload())
+        except BaseException as exc:
+            caught.append(exc)
+
+    t = threading.Thread(target=client)
+    with svc.batcher.pause():
+        t.start()
+        _wait_for(lambda: svc.batcher.queue_depth() == 1)
+        clk.advance(2.0)  # the deadline passes while the request is queued
+    t.join(timeout=30.0)
+    assert len(caught) == 1
+    assert isinstance(caught[0], DeadlineExceeded)
+    assert caught[0].status == 504
+    assert "queued for micro-batch" in str(caught[0])
+    assert svc.batcher.expired_in_queue == 1
+    assert svc.batcher.batches == 0  # expired rows never reach the device
+    svc.close()
+
+
+# --- hot swap atomicity -------------------------------------------------------
+
+
+def _zeroed(art: GBDTArtifact) -> GBDTArtifact:
+    """Every leaf 0 — margin 0, P(default) exactly 0.5 for any input, so a
+    swap to it is observable from any single prediction."""
+    return dataclasses.replace(
+        art,
+        forest=dataclasses.replace(
+            art.forest, leaf_value=jnp.zeros_like(art.forest.leaf_value)
+        ),
+    )
+
+
+def test_mid_batch_hot_swap_never_mixes_models(serving_artifact, tmp_path):
+    """Clients hammering predict_single while the model is hot-swapped see
+    either the old model's score or the new one's — never a mixture, and no
+    request errors. After the swap every new request scores on the new
+    model."""
+    shared, _ = serving_artifact
+    art = GBDTArtifact.load(shared, "models/gbdt/model_tree")
+    store = ObjectStore(str(tmp_path / "lake"))
+    art.save(store, "models/gbdt/model_tree")
+    svc = ScorerService.from_store(store, _cfg(max_wait_ms=1.0))
+    payload = _payload()
+    old_prob = svc.predict_single(payload)["prob_default"]
+    assert abs(old_prob - 0.5) > 1e-6, "seed model must not score exactly 0.5"
+    _zeroed(art).save(store, "models/gbdt/model_tree")
+
+    stop = threading.Event()
+    probs: list[float] = []
+    errors: list[BaseException] = []
+    lock = threading.Lock()
+
+    def client() -> None:
+        while not stop.is_set():
+            try:
+                p = svc.predict_single(payload)["prob_default"]
+            except BaseException as exc:
+                with lock:
+                    errors.append(exc)
+                return
+            with lock:
+                probs.append(p)
+
+    threads = [threading.Thread(target=client) for _ in range(8)]
+    for t in threads:
+        t.start()
+    _wait_for(lambda: len(probs) >= 16)  # traffic flowing pre-swap
+    result = svc.reload_from_store()
+    _wait_for(lambda: len(probs) >= 64)  # and post-swap
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+
+    assert result["status"] == "ok"
+    assert not errors, f"swap under load errored: {errors[:3]}"
+    for p in probs:
+        assert abs(p - old_prob) < 1e-6 or abs(p - 0.5) < 1e-9, (
+            f"score {p} belongs to neither the old nor the new model"
+        )
+    assert svc.predict_single(payload)["prob_default"] == pytest.approx(0.5)
+    svc.close()
+
+
+# --- warming, readiness, degrade, shutdown ------------------------------------
+
+
+def test_warming_precompiles_coalescing_cap_bucket(serving_artifact):
+    """Construction warms margin AND SHAP programs at the batcher's cap
+    bucket, and /readyz reports both warmed sets plus live batcher stats."""
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store, _cfg(max_rows=8))
+    assert 8 in svc.compiled_batch_buckets
+    assert svc.compiled_shap_buckets == (1, 8)
+    ready, payload = svc.ready()
+    assert ready
+    assert payload["compiled_shap_buckets"] == [1, 8]
+    mb = payload["microbatch"]
+    assert mb["enabled"] is True
+    assert mb["max_rows"] == 8
+    assert {"batches", "coalesced_rows", "queued", "expired_in_queue"} <= set(mb)
+    svc.close()
+
+    off = ScorerService.from_store(
+        store, dataclasses.replace(_cfg(), microbatch_enabled=False)
+    )
+    assert off.ready()[1]["microbatch"] == {"enabled": False}
+    off.close()
+
+
+def test_batched_shap_degrade_keeps_probability_contract(serving_artifact):
+    """SHAP unavailable (degraded model) with the batcher on: probabilities
+    still resolve through the coalesced dispatch, responses carry
+    shap_values null + degraded flag — same contract as the direct path."""
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store, _cfg())
+    svc._shap_fn = None  # the established degraded-model injection point
+    svc._shap_error = "injected: SHAP compile failed"
+    resp = svc.predict_single(_payload())
+    assert 0.0 <= resp["prob_default"] <= 1.0
+    assert resp["shap_values"] is None and resp["base_value"] is None
+    assert resp["degraded"] is True
+    assert svc.batcher.batches >= 1  # it went through the batched path
+    svc.close()
+
+
+def test_close_drains_and_falls_back_to_direct_path(serving_artifact):
+    """After close() the service keeps scoring on the per-request path —
+    the adapters call close() at shutdown and stragglers must not 500."""
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store, _cfg())
+    svc.close()
+    svc.close()  # idempotent
+    before = svc.batcher.batches
+    resp = svc.predict_single(_payload())
+    assert 0.0 <= resp["prob_default"] <= 1.0
+    assert len(resp["shap_values"]) == len(schema.SERVING_FEATURES)
+    assert svc.batcher.batches == before  # scored without the batcher

@@ -177,11 +177,18 @@ def test_start_deadline_none_disables():
 def test_predict_single_deadline_504_shape(serving_artifact):
     """With a ticking clock, the budget expires between the validation and
     SHAP checkpoints — and must surface as DeadlineExceeded, NOT be swallowed
-    into a degraded-SHAP 200."""
+    into a degraded-SHAP 200. Pinned to the direct (unbatched) path: the
+    micro-batcher's own deadline checkpoints are covered in
+    test_microbatch.py, and a ticking clock shared with the batcher thread
+    would advance nondeterministically."""
     store, _ = serving_artifact
     clk = TickingClock(tick=0.03)
     svc = ScorerService.from_store(
-        store, _cfg(request_deadline_s=0.05), clock=clk
+        store,
+        dataclasses.replace(
+            _cfg(request_deadline_s=0.05), microbatch_enabled=False
+        ),
+        clock=clk,
     )
     with pytest.raises(DeadlineExceeded) as ei:
         svc.predict_single(_valid_payload())

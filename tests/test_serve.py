@@ -142,8 +142,15 @@ def test_bulk_scoring_shape_buckets(serving_artifact):
     from cobalt_smart_lender_ai_tpu.config import ServeConfig
 
     store, X = serving_artifact
+    # microbatch off: its warming would pre-compile the coalescing cap bucket
+    # (covered in test_microbatch.py) and blur the cache-growth assertions.
     svc = ScorerService.from_store(
-        store, ServeConfig(max_batch_rows=64, precompile_batch_buckets=(8,))
+        store,
+        ServeConfig(
+            max_batch_rows=64,
+            precompile_batch_buckets=(8,),
+            microbatch_enabled=False,
+        ),
     )
     assert svc.compiled_batch_buckets == (1, 8)  # (1,F) reuse + warmed
     p5 = svc.predict_proba(X[:5])
