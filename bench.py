@@ -94,7 +94,14 @@ def run_protocol(n_rows: int, seed: int = 5) -> dict:
     t1 = time.time()
     result = run_pipeline(cfg, raw=raw)
     total = time.time() - t1
+
+    from cobalt_smart_lender_ai_tpu.telemetry import snapshot
+
     return {
+        # per-stage histogram observations + pipeline.run/stage spans, so the
+        # committed record carries the run's internal timings (README
+        # "Observability")
+        "telemetry": snapshot(span_limit=32),
         "metric": "full_protocol_rows_per_sec_per_chip",
         "produced_by": "bench.py --protocol (single process)",
         "value": round(n_rows / total, 1),
@@ -194,10 +201,13 @@ def main() -> None:
         # dispatch otherwise lies about wall-clock).
         return float(roc_auc(yd.astype(jnp.float32), margin, weight=test_w))
 
+    from cobalt_smart_lender_ai_tpu.telemetry import snapshot, span
+
     run(jax.random.PRNGKey(0))  # compile warmup
     with profile_trace(args.profile):
         t0 = time.time()
-        auc = run(jax.random.PRNGKey(1))
+        with span("bench.full_table_fit", rows=n, trees=N_TREES):
+            auc = run(jax.random.PRNGKey(1))
         elapsed = time.time() - t0
 
     rows_per_sec = n / elapsed
@@ -211,6 +221,7 @@ def main() -> None:
             "<60s north star requires)"
         ),
         "vs_baseline": round(rows_per_sec / NORTH_STAR_ROWS_PER_SEC_PER_CHIP, 2),
+        "telemetry": snapshot(span_limit=16),
     }
     # Ride the committed full-protocol measurement (bench.py --protocol, a
     # multi-hour run not repeated per invocation) along the single line, with

@@ -179,6 +179,112 @@ def run_load(
     return result
 
 
+def run_http_smoke(
+    config,
+    artifact,
+    payloads: list[dict],
+    *,
+    clients: int,
+    duration_s: float,
+) -> dict:
+    """Stand up the stdlib HTTP server on a loopback port, drive concurrent
+    POST /predict load over real sockets, and scrape ``GET /metrics`` both
+    mid-load and after — validating the exposition parses and the
+    request-latency histogram actually counted the traffic. This is the CI
+    gate for the telemetry wiring (tier1.yml bench-smoke job)."""
+    import http.client
+
+    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+    from cobalt_smart_lender_ai_tpu.telemetry import parse_exposition
+
+    service = ScorerService(artifact, config)
+    httpd = make_server(service)
+    port = httpd.server_address[1]
+    server_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    server_thread.start()
+
+    errors = [0] * clients
+    requests = [0] * clients
+    stop_at = time.monotonic() + duration_s
+
+    def client(idx: int) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        i = idx
+        while time.monotonic() < stop_at:
+            body = json.dumps(payloads[i % len(payloads)])
+            try:
+                conn.request(
+                    "POST",
+                    "/predict",
+                    body,
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                resp.read()
+                requests[idx] += 1
+                if resp.status != 200:
+                    errors[idx] += 1
+            except Exception:
+                errors[idx] += 1
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            i += 1
+        conn.close()
+
+    def scrape() -> tuple[str, str]:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            text = resp.read().decode()
+            return text, resp.getheader("Content-Type") or ""
+        finally:
+            conn.close()
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(clients)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        # scrape while the load is live: the endpoint must serve cleanly
+        # under concurrent traffic, not just at rest
+        time.sleep(duration_s / 2)
+        during_text, during_ctype = scrape()
+        parse_exposition(during_text)
+        for t in threads:
+            t.join()
+        final_text, _ = scrape()
+        families = parse_exposition(final_text)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        service.close()
+
+    latency = families.get("cobalt_request_latency_seconds", {"samples": {}})
+    latency_count = sum(
+        v
+        for k, v in latency["samples"].items()
+        if k.startswith("cobalt_request_latency_seconds_count")
+    )
+    batch_rows = families.get("cobalt_microbatch_batch_rows", {"samples": {}})
+    batch_count = sum(
+        v
+        for k, v in batch_rows["samples"].items()
+        if k.startswith("cobalt_microbatch_batch_rows_count")
+    )
+    return {
+        "requests": sum(requests),
+        "errors": sum(errors),
+        "families": len(families),
+        "scrape_during_load_ok": bool(during_ctype.startswith("text/plain")),
+        "request_latency_count": int(latency_count),
+        "microbatch_batch_count": int(batch_count),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--clients", type=int, default=32)
@@ -192,6 +298,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--microbatch-max-rows", type=int, default=None)
     parser.add_argument("--smoke", action="store_true",
                         help="CI profile: 4 clients, ~1s per mode")
+    parser.add_argument("--http-smoke", action="store_true",
+                        help="also drive load over real HTTP and scrape "
+                        "/metrics during it (validates the telemetry wiring; "
+                        "result lands under record['metrics_scrape'])")
     parser.add_argument("--out", default=None,
                         help="also write the JSON line to this path")
     args = parser.parse_args(argv)
@@ -250,7 +360,31 @@ def main(argv: list[str] | None = None) -> int:
             warmup_s=args.warmup_s,
             mix=args.mix,
         )
+        # attach this mode's metric values + recent spans so the committed
+        # bench record carries the run's internals, not just the headline
+        from cobalt_smart_lender_ai_tpu.telemetry import snapshot
+
+        results[f"batcher_{mode}"]["telemetry"] = snapshot(
+            service.registry, span_limit=32
+        )
+        artifact = service.artifact
         service.close()
+
+    if args.http_smoke:
+        print(
+            f"[bench] http smoke: {min(args.clients, 4)} clients over real "
+            "sockets, scraping /metrics...",
+            file=sys.stderr,
+        )
+        record_scrape = run_http_smoke(
+            ServeConfig(microbatch_enabled=True, **mb_kwargs),
+            artifact,
+            payloads,
+            clients=min(args.clients, 4),
+            duration_s=min(args.duration_s, 2.0),
+        )
+    else:
+        record_scrape = None
 
     record = {
         "bench": "serve_throughput",
@@ -260,6 +394,8 @@ def main(argv: list[str] | None = None) -> int:
         "platform": _platform_tag(),
         "results": results,
     }
+    if record_scrape is not None:
+        record["metrics_scrape"] = record_scrape
     if "batcher_on" in results and "batcher_off" in results:
         off, on = results["batcher_off"], results["batcher_on"]
         if off["qps"] > 0:

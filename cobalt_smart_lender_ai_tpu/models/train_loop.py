@@ -34,6 +34,7 @@ amortize by keeping fits long, not by re-calling.
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Any, Callable
 
@@ -43,8 +44,22 @@ import numpy as np
 import optax
 
 from cobalt_smart_lender_ai_tpu.ops.metrics import roc_auc
+from cobalt_smart_lender_ai_tpu.telemetry import (
+    default_registry,
+    log_buckets,
+    span,
+)
 
 Batch = Any  # pytree of arrays with a common leading row axis
+
+#: Host-observed epoch wall time. Epochs advance K at a time in one device
+#: dispatch, so each dispatch contributes K observations of its per-epoch
+#: average — the count stays "epochs trained" either way.
+_EPOCH_SECONDS = default_registry().histogram(
+    "cobalt_train_epoch_seconds",
+    "wall time per completed training epoch (fit_binary host loop)",
+    buckets=log_buckets(1e-3, 600.0, per_decade=2),
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -286,12 +301,20 @@ def fit_binary(
     )
     history = {"loss": [], "val_auc": []}
     for _ in range(-(-s.epochs // K)):
-        carry, (losses, aucs, ran) = super_step(carry)
-        # One host sync per K epochs: fetch the K-length history slices and
-        # the state scalar together.
-        losses, aucs, ran = (np.asarray(a) for a in (losses, aucs, ran))
+        t_step = time.monotonic()
+        with span("train.super_step", k=K, batch_size=bs):
+            carry, (losses, aucs, ran) = super_step(carry)
+            # One host sync per K epochs: fetch the K-length history slices
+            # and the state scalar together (the fetch is the sync point, so
+            # it belongs inside the span's timing).
+            losses, aucs, ran = (np.asarray(a) for a in (losses, aucs, ran))
         state = int(carry[5])
         ran_mask = ran > 0.5
+        n_ran = int(ran_mask.sum())
+        if n_ran:
+            per_epoch_s = (time.monotonic() - t_step) / n_ran
+            for _i in range(n_ran):
+                _EPOCH_SECONDS.observe(per_epoch_s)
         if state == 2:  # diverged: replicate the per-epoch loop's raise
             bad = int(np.flatnonzero(ran_mask)[-1])
             epoch = len(history["loss"]) + bad

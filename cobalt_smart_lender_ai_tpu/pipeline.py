@@ -77,8 +77,24 @@ from cobalt_smart_lender_ai_tpu.reliability import (
     config_fingerprint,
     policy_from_config,
 )
+from cobalt_smart_lender_ai_tpu.telemetry import (
+    default_registry,
+    log_buckets,
+    record_span,
+    span,
+)
 
 logger = logging.getLogger("cobalt_smart_lender_ai_tpu.pipeline")
+
+#: Stage wall times land in the process-wide registry so a bench or notebook
+#: run can dump them alongside the headline (`telemetry.snapshot()`). Stages
+#: run seconds-to-minutes, so the bounds run well past the latency defaults.
+_STAGE_SECONDS = default_registry().histogram(
+    "cobalt_pipeline_stage_seconds",
+    "wall time per pipeline stage (clean/engineer/rfe/search/refit/eval)",
+    ("stage",),
+    buckets=log_buckets(1e-2, 7200.0, per_decade=2),
+)
 
 
 @dataclasses.dataclass
@@ -123,7 +139,23 @@ def run_pipeline(
     frame is loaded from ``store``'s `raw_key` (the reference loads its input
     CSV from S3, model_tree_train_test.py:77). With ``resume=True`` (or
     ``config.reliability.resume``), stages whose checkpoint manifests still
-    validate are restored from the store instead of recomputed."""
+    validate are restored from the store instead of recomputed.
+
+    The whole run executes under a ``pipeline.run`` span; each stage records
+    a child span plus a ``cobalt_pipeline_stage_seconds{stage}`` observation
+    (both exported by `telemetry.snapshot`)."""
+    with span("pipeline.run", resume=bool(resume)):
+        return _run_pipeline(config, raw, store, mesh, model_key, resume)
+
+
+def _run_pipeline(
+    config: PipelineConfig | None,
+    raw: pd.DataFrame | None,
+    store: ObjectStore | None,
+    mesh,
+    model_key: str | None,
+    resume: bool | None,
+) -> PipelineResult:
     cfg = config or PipelineConfig()
     rel = cfg.reliability
     resume = rel.resume if resume is None else resume
@@ -132,8 +164,12 @@ def run_pipeline(
     stages_skipped: list[str] = []
 
     def tick(name: str, t0: float) -> float:
-        timings[name] = round(time.time() - t0, 3)
         t = time.time()
+        timings[name] = round(t - t0, 3)
+        _STAGE_SECONDS.labels(stage=name).observe(max(0.0, t - t0))
+        # after-the-fact span: the stage already measured itself; this
+        # registers it in the ring parented under pipeline.run
+        record_span(f"pipeline.{name}", t0, t)
         logger.info("%s done in %.2fs", name, timings[name])
         return t
 

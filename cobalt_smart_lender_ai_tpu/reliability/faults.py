@@ -13,10 +13,15 @@ from __future__ import annotations
 import dataclasses
 import random
 import time
+import weakref
 from collections import Counter
 from typing import Callable, Iterator, Mapping
 
 from cobalt_smart_lender_ai_tpu.io.store import ObjectStore
+from cobalt_smart_lender_ai_tpu.telemetry import (
+    MetricsRegistry,
+    default_registry,
+)
 
 
 class InjectedFault(ConnectionError):
@@ -72,6 +77,7 @@ class FaultInjectingStore(ObjectStore):
         seed: int = 0,
         faults: Mapping[str, FaultSpec] | None = None,
         sleep: Callable[[float], None] = time.sleep,
+        registry: MetricsRegistry | None = None,
     ):
         self.inner = inner
         self.uri = inner.uri
@@ -85,6 +91,53 @@ class FaultInjectingStore(ObjectStore):
         self.injected: Counter[str] = Counter()
         self.delays: Counter[str] = Counter()
         self.delayed_s: dict[str, float] = {}
+        self._register_metrics(
+            registry if registry is not None else default_registry()
+        )
+
+    def _register_metrics(self, reg: MetricsRegistry) -> None:
+        """Mirror the per-operation counters into the registry with
+        collect-time callbacks: the Counters above stay the single writer
+        (tests keep asserting on them), and a scrape during a fault drill
+        shows what the drill actually injected. Callbacks hold only a weak
+        reference — a collected store reads NaN, never a crash or a leak."""
+        self_ref = weakref.ref(self)
+
+        def _sample(attr: str, op: str) -> Callable[[], float]:
+            def read() -> float:
+                store = self_ref()
+                if store is None:
+                    raise LookupError("fault store was garbage-collected")
+                return float(getattr(store, attr).get(op, 0.0))
+
+            return read
+
+        families = (
+            (
+                "calls",
+                "cobalt_store_fault_calls_total",
+                "store calls seen by the fault-injecting wrapper",
+            ),
+            (
+                "injected",
+                "cobalt_store_faults_injected_total",
+                "faults injected (raised errors + corrupted reads)",
+            ),
+            (
+                "delays",
+                "cobalt_store_fault_delays_total",
+                "store calls given injected latency",
+            ),
+            (
+                "delayed_s",
+                "cobalt_store_fault_delay_seconds_total",
+                "total injected latency",
+            ),
+        )
+        for attr, name, help_text in families:
+            fam = reg.counter(name, help_text, ("op",))
+            for op in self.OPS:
+                fam.labels(op=op).set_function(_sample(attr, op))
 
     # -- fault engine ---------------------------------------------------------
     def _budget_left(self, op: str, spec: FaultSpec) -> bool:

@@ -46,11 +46,21 @@ def main() -> None:
         default=ServeConfig.microbatch_max_rows,
         help="dispatch early once this many requests are queued",
     )
+    parser.add_argument(
+        "--profile-dir",
+        default=None,
+        help="capture a jax.profiler trace of the whole serving session "
+        "into this directory (view in TensorBoard; telemetry spans appear "
+        "as TraceAnnotations on the same timeline)",
+    )
     args = parser.parse_args()
 
     # Scorer-bucket compiles persist across service restarts (tens of
     # seconds each on a cold backend; the cache makes a restart warm).
-    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+    from cobalt_smart_lender_ai_tpu.debug import (
+        enable_persistent_compile_cache,
+        profile_trace,
+    )
 
     enable_persistent_compile_cache()
     cfg = ServeConfig(
@@ -68,19 +78,22 @@ def main() -> None:
         print(f"[INFO] micro-batching on: wait {cfg.microbatch_max_wait_ms}ms, "
               f"max {cfg.microbatch_max_rows} rows/dispatch")
 
-    try:
-        import uvicorn  # noqa: F401
+    if args.profile_dir:
+        print(f"[INFO] profiler trace capturing to {args.profile_dir}")
+    with profile_trace(args.profile_dir):
+        try:
+            import uvicorn  # noqa: F401
 
-        from cobalt_smart_lender_ai_tpu.serve.http_fastapi import create_app
+            from cobalt_smart_lender_ai_tpu.serve.http_fastapi import create_app
 
-        app = create_app(service=service)
-        print(f"[INFO] serving (fastapi) on {cfg.host}:{cfg.port}")
-        uvicorn.run(app, host=cfg.host, port=cfg.port)
-    except ImportError:
-        from cobalt_smart_lender_ai_tpu.serve.http_stdlib import serve_forever
+            app = create_app(service=service)
+            print(f"[INFO] serving (fastapi) on {cfg.host}:{cfg.port}")
+            uvicorn.run(app, host=cfg.host, port=cfg.port)
+        except ImportError:
+            from cobalt_smart_lender_ai_tpu.serve.http_stdlib import serve_forever
 
-        print(f"[INFO] serving (stdlib) on {cfg.host}:{cfg.port}")
-        serve_forever(service, cfg.host, cfg.port)
+            print(f"[INFO] serving (stdlib) on {cfg.host}:{cfg.port}")
+            serve_forever(service, cfg.host, cfg.port)
 
 
 if __name__ == "__main__":

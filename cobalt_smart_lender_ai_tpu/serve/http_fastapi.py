@@ -7,11 +7,23 @@ including the two aliased field names with spaces and
 population-by-field-name. Error mapping is shared with the stdlib adapter
 through `reliability.errors.error_response`, so both adapters emit the same
 taxonomy (422/413/429/503/504 with ``Retry-After`` where applicable), and
-both expose the same ``POST /admin/reload`` hot-swap endpoint.
+both expose the same ``POST /admin/reload`` hot-swap endpoint and
+``GET /metrics`` Prometheus exposition.
+
+Telemetry (mirrored in `http_stdlib.py`): each route body runs inside
+`_track(route, ...)` — a per-request envelope that binds the request-id
+context (honoring the client's ``X-Request-ID``, echoing the id on the
+response), records wall time into
+``cobalt_request_latency_seconds{route,status}`` with the route *template*
+as the label (bounded cardinality), and logs one structured JSON line per
+non-2xx with the typed error code. The envelope lives in the handlers, not
+ASGI middleware, so it also executes under the in-repo stub harness
+(`tests/test_serve_fastapi_stub.py`), which calls handlers directly.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 from cobalt_smart_lender_ai_tpu.config import ServeConfig
@@ -23,15 +35,37 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
     error_response,
 )
 from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+from cobalt_smart_lender_ai_tpu.telemetry import (
+    EXPOSITION_CONTENT_TYPE,
+    get_logger,
+    request_context,
+)
+
+_LOG = get_logger("cobalt.serve.http")
 
 
 def create_app(service: ScorerService | None = None, store_uri: str | None = None):
     """Build the FastAPI app. Pass a ready `service` (tests) or a `store_uri`
     to restore the model at startup like the reference's lifespan hook
     (cobalt_fast_api.py:36-54)."""
-    from contextlib import asynccontextmanager
+    from contextlib import asynccontextmanager, contextmanager
 
     from fastapi import FastAPI, File, HTTPException, UploadFile
+
+    try:
+        from fastapi import Request, Response
+    except ImportError:
+        # Minimal in-test fastapi stubs may not model Request/Response; the
+        # handlers only touch them when the harness passes real ones (the
+        # annotations stay strings via `from __future__ import annotations`).
+        Request = None
+
+        class Response:
+            def __init__(self, content=None, media_type=None):
+                self.content = content
+                self.media_type = media_type
+                self.headers: dict[str, str] = {}
+
     from pydantic import BaseModel, ConfigDict, Field
 
     class SingleInput(BaseModel):
@@ -88,58 +122,122 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
 
     def _raise_typed(exc: RequestError) -> None:
         status, body, headers = error_response(exc)
-        raise HTTPException(
+        http_exc = HTTPException(
             status_code=status, detail=body["detail"], headers=headers or None
         )
+        # carried for the `_track` envelope: the machine-readable code from
+        # the shared taxonomy, not just the HTTP status
+        http_exc.cobalt_code = body.get("error")
+        raise http_exc
+
+    @contextmanager
+    def _track(route: str, request, response):
+        """Per-request telemetry envelope (see module docstring). `request`
+        and `response` are None under the stub harness, which calls the
+        handlers directly — the envelope still times, counts and logs."""
+        rid_header = None
+        if request is not None:
+            headers = getattr(request, "headers", None)
+            if headers is not None:
+                rid_header = headers.get("X-Request-ID")
+        t0 = time.monotonic()
+        with request_context(rid_header or None) as rid:
+            if response is not None:
+                response.headers["X-Request-ID"] = rid
+            status, code = 200, None
+            try:
+                yield
+            except HTTPException as e:
+                status = e.status_code
+                code = getattr(e, "cobalt_code", None)
+                raise
+            except Exception:
+                status, code = 500, "internal"
+                raise
+            finally:
+                duration_s = time.monotonic() - t0
+                state["service"].observe_request(
+                    route, status, duration_s, code=code
+                )
+                if status >= 400:
+                    _LOG.warning(
+                        "request_error",
+                        route=route,
+                        status=status,
+                        code=code or "error",
+                        duration_ms=round(duration_s * 1000.0, 3),
+                    )
 
     @app.post("/predict")
-    def predict_single(input_data: SingleInput):
-        try:
-            with state["service"].admission.admit():
-                return state["service"].predict_single(
-                    input_data.model_dump(by_alias=True)
-                )
-        except RequestError as e:
-            _raise_typed(e)
+    def predict_single(
+        input_data: SingleInput, request: Request = None, response: Response = None
+    ):
+        with _track("/predict", request, response):
+            try:
+                with state["service"].admission.admit():
+                    return state["service"].predict_single(
+                        input_data.model_dump(by_alias=True)
+                    )
+            except RequestError as e:
+                _raise_typed(e)
 
     @app.post("/predict_bulk_csv")
-    async def predict_bulk_csv(file: UploadFile = File(...)):
-        body = await file.read()
-        try:
-            with state["service"].admission.admit():
-                return state["service"].predict_bulk_csv(body)
-        except RequestError as e:
-            _raise_typed(e)
-        except Exception as e:
-            raise HTTPException(
-                status_code=500, detail=f"Bulk prediction failed: {e}"
-            )
+    async def predict_bulk_csv(
+        file: UploadFile = File(...),
+        request: Request = None,
+        response: Response = None,
+    ):
+        with _track("/predict_bulk_csv", request, response):
+            body = await file.read()
+            try:
+                with state["service"].admission.admit():
+                    return state["service"].predict_bulk_csv(body)
+            except RequestError as e:
+                _raise_typed(e)
+            except Exception as e:
+                exc = HTTPException(
+                    status_code=500, detail=f"Bulk prediction failed: {e}"
+                )
+                exc.cobalt_code = "bulk_failed"
+                raise exc
 
     @app.post("/feature_importance_bulk")
-    def feature_importance_bulk(data: BulkInput):
-        try:
-            with state["service"].admission.admit():
-                return state["service"].feature_importance_bulk(data.model_dump())
-        except ValidationError as e:
-            # this route 400s on empty data in the reference
-            # (cobalt_fast_api.py:131), not 422
-            raise HTTPException(status_code=400, detail=str(e))
-        except RequestError as e:
-            _raise_typed(e)
+    def feature_importance_bulk(
+        data: BulkInput, request: Request = None, response: Response = None
+    ):
+        with _track("/feature_importance_bulk", request, response):
+            try:
+                with state["service"].admission.admit():
+                    return state["service"].feature_importance_bulk(
+                        data.model_dump()
+                    )
+            except ValidationError as e:
+                # this route 400s on empty data in the reference
+                # (cobalt_fast_api.py:131), not 422
+                exc = HTTPException(status_code=400, detail=str(e))
+                exc.cobalt_code = "invalid_input"
+                raise exc
+            except RequestError as e:
+                _raise_typed(e)
 
     @app.post("/admin/reload")
-    def admin_reload(data: ReloadInput):
+    def admin_reload(
+        data: ReloadInput, request: Request = None, response: Response = None
+    ):
         # Admin plane: never gated by scoring admission — an operator must be
         # able to swap in a fixed model while the data plane is shedding.
-        try:
-            result = state["service"].reload_from_store(
-                model_key=data.model_key
-            )
-        except RequestError as e:  # breaker open -> 503 + Retry-After
-            _raise_typed(e)
-        if result["status"] != "ok":
-            raise HTTPException(status_code=500, detail=result)
-        return result
+        with _track("/admin/reload", request, response):
+            try:
+                result = state["service"].reload_from_store(
+                    model_key=data.model_key
+                )
+            except RequestError as e:  # breaker open -> 503 + Retry-After
+                _raise_typed(e)
+            if result["status"] != "ok":
+                exc = HTTPException(status_code=500, detail=result)
+                exc.cobalt_code = "reload_failed"
+                raise exc
+            return result
 
     @app.get("/healthz")
     def healthz():
@@ -153,5 +251,12 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
             # 503 means the instance cannot score at all
             raise HTTPException(status_code=503, detail=payload)
         return payload
+
+    @app.get("/metrics")
+    def metrics():
+        return Response(
+            content=state["service"].registry.render(),
+            media_type=EXPOSITION_CONTENT_TYPE,
+        )
 
     return app

@@ -12,6 +12,8 @@ match the reference:
 - ``POST /predict_bulk_csv``      — multipart file upload or raw CSV body
 - ``POST /feature_importance_bulk`` — JSON ``{"data": [...]}``, 400 if empty
 - ``POST /admin/reload``          — hot model swap (optional ``model_key``)
+- ``GET /metrics``                — Prometheus text exposition of
+  ``service.registry`` (README "Observability")
 
 Errors return ``{"detail": ...}`` like FastAPI's HTTPException, plus a stable
 machine-readable ``"error"`` code from `reliability.errors` — the taxonomy
@@ -20,6 +22,14 @@ guarantees"). Scoring routes are gated by `service.admission` (shed → 429
 with ``Retry-After``) and honor the per-request deadline (504). The handler
 is threaded (one TPU dispatch at a time is serialized by JAX itself, so a
 ThreadingHTTPServer is safe).
+
+Telemetry middleware (mirrored in `http_fastapi.py`): every request runs
+inside a `request_context` — the client's ``X-Request-ID`` is honored,
+otherwise one is minted, and either way the id is echoed on the response —
+its wall time lands in the ``cobalt_request_latency_seconds{route,status}``
+histogram (route is the matched template, never the raw path, so label
+cardinality stays bounded), and every non-2xx emits one structured JSON log
+line carrying the request id, route and typed error code.
 """
 
 from __future__ import annotations
@@ -27,6 +37,7 @@ from __future__ import annotations
 import email.parser
 import email.policy
 import json
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from cobalt_smart_lender_ai_tpu.reliability.errors import (
@@ -35,6 +46,27 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
     error_response,
 )
 from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+from cobalt_smart_lender_ai_tpu.telemetry import (
+    EXPOSITION_CONTENT_TYPE,
+    get_logger,
+    request_context,
+)
+
+_LOG = get_logger("cobalt.serve.http")
+
+#: Routes that become metric label values. Anything else is folded into
+#: "unmatched" — a path-scanning client must not mint one label per probe.
+_KNOWN_ROUTES = frozenset(
+    {
+        "/predict",
+        "/predict_bulk_csv",
+        "/feature_importance_bulk",
+        "/admin/reload",
+        "/healthz",
+        "/readyz",
+        "/metrics",
+    }
+)
 
 
 def _extract_csv(body: bytes, content_type: str) -> bytes:
@@ -62,15 +94,29 @@ def make_handler(service: ScorerService):
         def log_message(self, fmt, *args):  # noqa: D102
             pass
 
-        def _send(self, code: int, obj, headers: dict | None = None) -> None:
-            data = json.dumps(obj).encode()
+        # -- response plumbing (status/code captured for the middleware) ----
+
+        def _send_bytes(
+            self, code: int, data: bytes, content_type: str,
+            headers: dict | None = None,
+        ) -> None:
+            self._status = code
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
+            if self._request_id:
+                self.send_header("X-Request-ID", self._request_id)
             for name, value in (headers or {}).items():
                 self.send_header(name, value)
             self.end_headers()
             self.wfile.write(data)
+
+        def _send(self, code: int, obj, headers: dict | None = None) -> None:
+            if code >= 400 and isinstance(obj, dict):
+                self._error_code = obj.get("error")
+            self._send_bytes(
+                code, json.dumps(obj).encode(), "application/json", headers
+            )
 
         def _json_body(self, body: bytes):
             try:
@@ -78,60 +124,105 @@ def make_handler(service: ScorerService):
             except (UnicodeDecodeError, json.JSONDecodeError):
                 raise ValidationError("body is not valid JSON")
 
+        # -- telemetry middleware ------------------------------------------
+
+        def _handle(self, method: str) -> None:
+            """Per-request envelope shared by GET and POST: request-id
+            context, typed-error mapping, latency observation, structured
+            error log."""
+            route = self.path if self.path in _KNOWN_ROUTES else "unmatched"
+            self._status: int | None = None
+            self._error_code: str | None = None
+            self._request_id: str | None = None
+            t0 = time.monotonic()
+            with request_context(
+                self.headers.get("X-Request-ID") or None
+            ) as rid:
+                self._request_id = rid
+                try:
+                    if method == "POST":
+                        self._post()
+                    else:
+                        self._get()
+                except RequestError as e:
+                    self._send(*error_response(e))
+                except Exception as e:  # pragma: no cover
+                    self._send(
+                        500,
+                        {
+                            "detail": f"Internal server error: {e}",
+                            "error": "internal",
+                        },
+                    )
+                duration_s = time.monotonic() - t0
+                status = self._status if self._status is not None else 500
+                service.observe_request(
+                    route, status, duration_s, code=self._error_code
+                )
+                if status >= 400:
+                    _LOG.warning(
+                        "request_error",
+                        method=method,
+                        route=route,
+                        status=status,
+                        code=self._error_code or "error",
+                        duration_ms=round(duration_s * 1000.0, 3),
+                    )
+
         def do_POST(self):  # noqa: N802 - http.server API
+            self._handle("POST")
+
+        def do_GET(self):  # noqa: N802
+            self._handle("GET")
+
+        # -- routes --------------------------------------------------------
+
+        def _post(self) -> None:
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)
-            try:
-                if self.path == "/admin/reload":
-                    # Admin plane: never gated by scoring admission — an
-                    # operator must be able to swap in a fixed model while the
-                    # data plane is shedding.
-                    self._admin_reload(body)
-                    return
-                if self.path == "/predict":
-                    with service.admission.admit():
-                        self._send(
-                            200, service.predict_single(self._json_body(body))
+            if self.path == "/admin/reload":
+                # Admin plane: never gated by scoring admission — an
+                # operator must be able to swap in a fixed model while the
+                # data plane is shedding.
+                self._admin_reload(body)
+                return
+            if self.path == "/predict":
+                with service.admission.admit():
+                    self._send(
+                        200, service.predict_single(self._json_body(body))
+                    )
+            elif self.path == "/predict_bulk_csv":
+                with service.admission.admit():
+                    try:
+                        csv_bytes = _extract_csv(
+                            body, self.headers.get("Content-Type", "")
                         )
-                elif self.path == "/predict_bulk_csv":
-                    with service.admission.admit():
-                        try:
-                            csv_bytes = _extract_csv(
-                                body, self.headers.get("Content-Type", "")
-                            )
-                            self._send(200, service.predict_bulk_csv(csv_bytes))
-                        except RequestError:
-                            raise  # typed errors keep their status (422/413/504)
-                        except Exception as e:
-                            # parity with the reference's try/except -> HTTP 500
-                            # on the bulk route (cobalt_fast_api.py:124-126)
-                            self._send(
-                                500,
-                                {
-                                    "detail": f"Bulk prediction failed: {e}",
-                                    "error": "bulk_failed",
-                                },
-                            )
-                elif self.path == "/feature_importance_bulk":
-                    with service.admission.admit():
-                        payload = self._json_body(body)  # malformed JSON -> 422
-                        try:
-                            self._send(
-                                200, service.feature_importance_bulk(payload)
-                            )
-                        except ValidationError as e:
-                            # this route 400s on empty data in the reference
-                            # (cobalt_fast_api.py:131), not 422
-                            self._send(400, e.body())
-                else:
-                    self._send(404, {"detail": "Not Found"})
-            except RequestError as e:
-                self._send(*error_response(e))
-            except Exception as e:  # pragma: no cover
-                self._send(
-                    500,
-                    {"detail": f"Internal server error: {e}", "error": "internal"},
-                )
+                        self._send(200, service.predict_bulk_csv(csv_bytes))
+                    except RequestError:
+                        raise  # typed errors keep their status (422/413/504)
+                    except Exception as e:
+                        # parity with the reference's try/except -> HTTP 500
+                        # on the bulk route (cobalt_fast_api.py:124-126)
+                        self._send(
+                            500,
+                            {
+                                "detail": f"Bulk prediction failed: {e}",
+                                "error": "bulk_failed",
+                            },
+                        )
+            elif self.path == "/feature_importance_bulk":
+                with service.admission.admit():
+                    payload = self._json_body(body)  # malformed JSON -> 422
+                    try:
+                        self._send(
+                            200, service.feature_importance_bulk(payload)
+                        )
+                    except ValidationError as e:
+                        # this route 400s on empty data in the reference
+                        # (cobalt_fast_api.py:131), not 422
+                        self._send(400, e.body())
+            else:
+                self._send(404, {"detail": "Not Found"})
 
         def _admin_reload(self, body: bytes) -> None:
             payload = self._json_body(body)
@@ -153,7 +244,7 @@ def make_handler(service: ScorerService):
                     },
                 )
 
-        def do_GET(self):  # noqa: N802
+        def _get(self) -> None:
             if self.path == "/healthz":
                 self._send(200, service.health())
             elif self.path == "/readyz":
@@ -161,6 +252,12 @@ def make_handler(service: ScorerService):
                 # degraded-but-scorable is still 200: readiness gates traffic
                 # on the probability contract, not the SHAP enrichment
                 self._send(200 if ready else 503, payload)
+            elif self.path == "/metrics":
+                self._send_bytes(
+                    200,
+                    service.registry.render().encode(),
+                    EXPOSITION_CONTENT_TYPE,
+                )
             else:
                 self._send(404, {"detail": "Not Found"})
 

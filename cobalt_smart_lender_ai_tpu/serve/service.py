@@ -73,6 +73,18 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
     PayloadTooLarge,
     ValidationError,
 )
+from cobalt_smart_lender_ai_tpu.telemetry import (
+    MetricsRegistry,
+    current_request_id,
+    default_tracer,
+    get_logger,
+)
+
+_LOG = get_logger("cobalt.serve")
+
+#: Power-of-two row buckets for the coalesced-batch-size histogram — batch
+#: sizes are already padded to powers of two, so these bounds are exact.
+_BATCH_ROW_BUCKETS = tuple(float(1 << i) for i in range(11))  # 1 .. 1024
 
 __all__ = [
     "SINGLE_INPUT_FIELDS",
@@ -342,7 +354,9 @@ class MicroBatcher:
     - a SHAP failure degrades the whole batch's attributions (probabilities
       still resolve), mirroring the direct path's per-request degrade.
 
-    All counters are observable via `stats()` and surfaced in ``/readyz``.
+    All counters are registry-backed (`telemetry.metrics`, scrapeable at
+    ``GET /metrics``); `stats()` and ``/readyz`` serve the same values from
+    the same cells, so the pre-telemetry wire contract is unchanged.
     """
 
     def __init__(
@@ -356,21 +370,73 @@ class MicroBatcher:
         self._max_wait_s = max(0.0, float(max_wait_s))
         self._max_rows = max(1, int(max_rows))
         self._cond = threading.Condition()
-        self._queue: list[tuple[Mapping[str, float], Deadline | None, Future]] = []
+        # queue entries: (row, deadline, future, enqueued_monotonic,
+        # request_id) — the request id is captured at submit time because
+        # dispatch happens on this worker thread, where the submitter's
+        # contextvar is not live.
+        self._queue: list[tuple] = []
         # Held for the whole model-snapshot -> dispatch -> resolve span of a
         # batch; `reload_from_store` publishes under it (see `pause`).
         self._dispatch_lock = threading.Lock()
         self._paused = 0
         self._closed = False
         self._scratch: np.ndarray | None = None  # worker-only padding buffer
-        self.batches = 0
-        self.coalesced_rows = 0
-        self.max_batch_rows = 0
-        self.expired_in_queue = 0
+        reg = service.registry
+        self._m_batches = reg.counter(
+            "cobalt_microbatch_batches_total",
+            "coalesced device dispatches run by the micro-batch scheduler",
+        )
+        self._m_rows = reg.counter(
+            "cobalt_microbatch_rows_total",
+            "request rows scored through coalesced micro-batches",
+        )
+        self._m_batch_rows = reg.histogram(
+            "cobalt_microbatch_batch_rows",
+            "distribution of coalesced batch sizes (rows per dispatch)",
+            buckets=_BATCH_ROW_BUCKETS,
+        )
+        self._m_coalesce_wait = reg.histogram(
+            "cobalt_microbatch_coalesce_wait_seconds",
+            "time a request spent queued before its batch dispatched",
+        )
+        self._m_expired = reg.counter(
+            "cobalt_microbatch_expired_total",
+            "requests resolved 504 by the batcher, by where the deadline "
+            "was detected (queued: before a batch slot; scored: after the "
+            "un-interruptible dispatch)",
+            ("where",),
+        )
+        self._m_max_batch = reg.gauge(
+            "cobalt_microbatch_max_batch_rows",
+            "largest batch coalesced so far (high-water mark)",
+        )
+        reg.gauge(
+            "cobalt_microbatch_queue_depth",
+            "requests currently waiting for a batch slot",
+        ).set_function(self.queue_depth)
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="microbatcher"
         )
         self._thread.start()
+
+    # registry-backed counter views — the pre-telemetry public attributes
+    # (tests and /readyz read these; the registry cells are the storage)
+
+    @property
+    def batches(self) -> int:
+        return int(self._m_batches.value)
+
+    @property
+    def coalesced_rows(self) -> int:
+        return int(self._m_rows.value)
+
+    @property
+    def max_batch_rows(self) -> int:
+        return int(self._m_max_batch.value)
+
+    @property
+    def expired_in_queue(self) -> int:
+        return int(self._m_expired.labels(where="queued").value)
 
     @property
     def closed(self) -> bool:
@@ -383,10 +449,11 @@ class MicroBatcher:
         ``(prob, shap_row | None, base_value | None, shap_error | None)`` or
         raises the request's typed error."""
         fut: Future = Future()
+        entry = (row, deadline, fut, time.monotonic(), current_request_id())
         with self._cond:
             if self._closed:
                 raise RuntimeError("micro-batcher is closed")
-            self._queue.append((row, deadline, fut))
+            self._queue.append(entry)
             self._cond.notify_all()
         return fut
 
@@ -467,59 +534,73 @@ class MicroBatcher:
                 try:
                     self._dispatch(batch)
                 except BaseException as exc:  # the worker must never die
-                    for _, _, fut in batch:
+                    for _, _, fut, _, _ in batch:
                         if not fut.done():
                             fut.set_exception(exc)
 
     def _dispatch(self, batch: list) -> None:
         model = self._service._model  # ONE snapshot: a batch never mixes models
+        now = time.monotonic()
         live = []
-        for row, dl, fut in batch:
+        for row, dl, fut, enq_t, rid in batch:
             if dl is not None and dl.expired():
-                self.expired_in_queue += 1
+                self._m_expired.labels(where="queued").inc()
                 fut.set_exception(dl.exceeded("queued for micro-batch"))
             else:
-                live.append((row, dl, fut))
+                live.append((row, dl, fut, enq_t, rid))
         if not live:
             return
         n = len(live)
+        for _, _, _, enq_t, _ in live:
+            self._m_coalesce_wait.observe(now - enq_t)
         bucket = model.bucket_of(n)
-        scratch = self._scratch
-        if (
-            scratch is None
-            or scratch.shape[0] < bucket
-            or scratch.shape[1] != model.n_features
+        # The span carries the submitters' request ids: the dispatch runs on
+        # this worker thread, so the ids captured at submit are the only
+        # link from a batch back to the requests it scored.
+        with default_tracer().span(
+            "serve.microbatch_dispatch",
+            rows=n,
+            bucket=bucket,
+            request_ids=[rid for _, _, _, _, rid in live if rid],
         ):
-            scratch = self._scratch = np.zeros(
-                (bucket, model.n_features), np.float32
-            )
-        buf = scratch[:bucket]
-        buf[:n] = model.rows_array([row for row, _, _ in live])
-        buf[n:] = 0.0
-        xb = jnp.asarray(buf)
-        probs = np.asarray(
-            jax.nn.sigmoid(model.margin_for_bucket(bucket)(xb))
-        )[:n]
-        phis = base = None
-        shap_error: str | None = None
-        shap_fn = model.shap_for_bucket(bucket)
-        if shap_fn is None:
-            shap_error = model.shap_error or "SHAP program unavailable"
-        else:
-            try:
-                phis_all, base_v = shap_fn(xb)
-                phis = np.asarray(phis_all)[:n]
-                base = float(base_v)
-            except Exception as exc:
-                shap_error = f"{type(exc).__name__}: {exc}"
-        self.batches += 1
-        self.coalesced_rows += n
-        self.max_batch_rows = max(self.max_batch_rows, n)
-        for i, (_, dl, fut) in enumerate(live):
+            scratch = self._scratch
+            if (
+                scratch is None
+                or scratch.shape[0] < bucket
+                or scratch.shape[1] != model.n_features
+            ):
+                scratch = self._scratch = np.zeros(
+                    (bucket, model.n_features), np.float32
+                )
+            buf = scratch[:bucket]
+            buf[:n] = model.rows_array([row for row, _, _, _, _ in live])
+            buf[n:] = 0.0
+            xb = jnp.asarray(buf)
+            probs = np.asarray(
+                jax.nn.sigmoid(model.margin_for_bucket(bucket)(xb))
+            )[:n]
+            phis = base = None
+            shap_error: str | None = None
+            shap_fn = model.shap_for_bucket(bucket)
+            if shap_fn is None:
+                shap_error = model.shap_error or "SHAP program unavailable"
+            else:
+                try:
+                    phis_all, base_v = shap_fn(xb)
+                    phis = np.asarray(phis_all)[:n]
+                    base = float(base_v)
+                except Exception as exc:
+                    shap_error = f"{type(exc).__name__}: {exc}"
+        self._m_batches.inc()
+        self._m_rows.inc(n)
+        self._m_batch_rows.observe(n)
+        self._m_max_batch.set_max(n)
+        for i, (_, dl, fut, _, _) in enumerate(live):
             if dl is not None and dl.expired():
                 # The dispatch itself cannot be interrupted; past the
                 # deadline the client is gone — 504, not a late 200 (the
                 # direct path's post-scoring checkpoint).
+                self._m_expired.labels(where="scored").inc()
                 fut.set_exception(dl.exceeded("micro-batch scored"))
                 continue
             fut.set_result(
@@ -548,14 +629,22 @@ class ScorerService:
         store: ObjectStore | None = None,
         clock: Callable[[], float] = time.monotonic,
         breaker: CircuitBreaker | None = None,
+        registry: MetricsRegistry | None = None,
     ):
         self.config = config or ServeConfig()
         self._clock = clock
         self._store = store
         self._model_key = self.config.model_key
+        # Fresh registry per service by default: a service owns its metric
+        # cells the way it owns its admission counters, so two services in
+        # one process (tests, bench A/B modes) never share counts. Pass
+        # ``registry=default_registry()`` to merge with the process-wide
+        # registry (pipeline/train metrics) on one scrape.
+        self.registry = registry if registry is not None else MetricsRegistry()
         rel = self.config.reliability
         self.store_breaker = breaker or breaker_from_config(rel, clock=clock)
         self.admission = admission_from_config(rel, clock=clock)
+        self._init_metrics()
         # One reload at a time; request threads never take this lock — they
         # read `_model` once and run against that snapshot.
         self._swap_lock = threading.Lock()
@@ -571,6 +660,86 @@ class ScorerService:
                     self.config.max_batch_rows,
                 ),
             )
+
+    def _init_metrics(self) -> None:
+        """Register the service-level metric families (README
+        "Observability"). The admission controller and circuit breaker keep
+        their own counters as the source of truth (`stats()` / ``/readyz``
+        read them directly); the registry mirrors them with collect-time
+        callbacks, so one scrape sees the same numbers without double
+        bookkeeping on the request path."""
+        reg = self.registry
+        self._m_latency = reg.histogram(
+            "cobalt_request_latency_seconds",
+            "request wall time by route and final HTTP status",
+            ("route", "status"),
+        )
+        self._m_errors = reg.counter(
+            "cobalt_request_errors_total",
+            "non-2xx responses by route and typed error code",
+            ("route", "code"),
+        )
+        self._m_shap_degraded = reg.counter(
+            "cobalt_shap_degraded_total",
+            "scorable requests answered without SHAP attributions",
+        )
+        self._m_reloads = reg.counter(
+            "cobalt_model_reloads_total",
+            "hot model swap attempts by outcome (ok / rolled_back)",
+            ("status",),
+        )
+        adm = self.admission
+        reg.gauge(
+            "cobalt_admission_in_flight",
+            "scoring requests currently holding an admission slot",
+        ).set_function(lambda: adm.in_flight)
+        reg.counter(
+            "cobalt_admission_admitted_total",
+            "scoring requests admitted past both admission gates",
+        ).set_function(lambda: adm.admitted)
+        shed = reg.counter(
+            "cobalt_admission_shed_total",
+            "requests shed 429 at the door, by which gate refused them",
+            ("gate",),
+        )
+        shed.labels(gate="rate").set_function(lambda: adm.shed_rate)
+        shed.labels(gate="capacity").set_function(lambda: adm.shed_capacity)
+        brk = self.store_breaker
+        reg.gauge(
+            "cobalt_breaker_state",
+            "store circuit breaker state (0=closed, 1=half_open, 2=open)",
+        ).set_function(
+            lambda: {"closed": 0, "half_open": 1, "open": 2}.get(brk.state, -1)
+        )
+        trans = reg.counter(
+            "cobalt_breaker_transitions_total",
+            "store circuit breaker transitions into each state",
+            ("state",),
+        )
+        for state in ("closed", "half_open", "open"):
+            trans.labels(state=state).set_function(
+                lambda s=state: brk.transitions.count(s)
+            )
+        reg.counter(
+            "cobalt_breaker_fast_failures_total",
+            "store calls rejected while the circuit was open",
+        ).set_function(lambda: brk.fast_failures)
+
+    def observe_request(
+        self,
+        route: str,
+        status: int,
+        duration_s: float,
+        code: str | None = None,
+    ) -> None:
+        """Record one finished HTTP request — both adapters call this from
+        their middleware with the normalized route template (never a raw
+        path: label cardinality must stay bounded)."""
+        self._m_latency.labels(route=route, status=str(status)).observe(
+            max(0.0, duration_s)
+        )
+        if status >= 400:
+            self._m_errors.labels(route=route, code=code or "error").inc()
 
     def close(self) -> None:
         """Stop the micro-batch worker (drains queued requests first);
@@ -639,6 +808,7 @@ class ScorerService:
         config: ServeConfig | None = None,
         *,
         clock: Callable[[], float] = time.monotonic,
+        registry: MetricsRegistry | None = None,
     ) -> "ScorerService":
         """Startup restore — the lifespan S3 download + joblib.load of
         `cobalt_fast_api.py:42-47`, run under the circuit breaker so a dead
@@ -647,7 +817,14 @@ class ScorerService:
         cfg = config or ServeConfig()
         brk = breaker_from_config(cfg.reliability, clock=clock)
         artifact = brk.call(lambda: GBDTArtifact.load(store, cfg.model_key))
-        return cls(artifact, cfg, store=store, clock=clock, breaker=brk)
+        return cls(
+            artifact,
+            cfg,
+            store=store,
+            clock=clock,
+            breaker=brk,
+            registry=registry,
+        )
 
     # -- hot model swap --------------------------------------------------------
 
@@ -715,6 +892,8 @@ class ScorerService:
                     "model_key": key,
                     "error": f"{type(exc).__name__}: {exc}",
                 }
+                self._m_reloads.labels(status="rolled_back").inc()
+                _LOG.warning("model_reload", **self._last_reload)
                 return self._last_reload
             # Publish under the batcher's dispatch lock: the in-flight batch
             # (which snapshotted the old _CompiledModel) drains fully before
@@ -734,6 +913,8 @@ class ScorerService:
                 "model_key": key,
                 "n_features": candidate.n_features,
             }
+            self._m_reloads.labels(status="ok").inc()
+            _LOG.info("model_reload", **self._last_reload)
             return self._last_reload
 
     # -- scoring helpers ------------------------------------------------------
@@ -835,6 +1016,7 @@ class ScorerService:
                 resp["shap_values"] = None
                 resp["base_value"] = None
                 resp["degraded"] = True
+                self._m_shap_degraded.inc()
             return resp
         model = self._model
         x = model.row_array(row)
@@ -873,6 +1055,7 @@ class ScorerService:
             resp["shap_values"] = None
             resp["base_value"] = None
             resp["degraded"] = True
+            self._m_shap_degraded.inc()
         return resp
 
     def predict_bulk_csv(

@@ -1,0 +1,85 @@
+"""Telemetry: metrics registry + structured logs + spans (README
+"Observability").
+
+Dependency-free by construction — the serving image has no prometheus_client
+or opentelemetry, and the pipeline must not grow imports the training image
+lacks. Three legs, one package:
+
+- `telemetry.metrics` — labeled Counter/Gauge/Histogram families in a
+  thread-safe `MetricsRegistry`, rendered in Prometheus text exposition
+  format at ``GET /metrics`` on both HTTP adapters.
+- `telemetry.logging` — one-JSON-object-per-line logs with a
+  contextvar-propagated request id (honoring/emitting ``X-Request-ID``).
+- `telemetry.tracing` — `span()` context manager with parent/child nesting,
+  an injectable clock, a bounded ring buffer with JSON export, and
+  pass-through to ``jax.profiler.TraceAnnotation`` during profiler captures.
+"""
+
+from __future__ import annotations
+
+from cobalt_smart_lender_ai_tpu.telemetry.logging import (
+    StructuredLogger,
+    current_request_id,
+    get_logger,
+    new_request_id,
+    request_context,
+)
+from cobalt_smart_lender_ai_tpu.telemetry.metrics import (
+    EXPOSITION_CONTENT_TYPE,
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    log_buckets,
+    parse_exposition,
+    render,
+)
+from cobalt_smart_lender_ai_tpu.telemetry.tracing import (
+    Span,
+    Tracer,
+    default_tracer,
+    record_span,
+    span,
+)
+
+__all__ = [
+    "EXPOSITION_CONTENT_TYPE",
+    "LATENCY_BUCKETS_S",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "StructuredLogger",
+    "Tracer",
+    "current_request_id",
+    "default_registry",
+    "default_tracer",
+    "get_logger",
+    "log_buckets",
+    "new_request_id",
+    "parse_exposition",
+    "record_span",
+    "render",
+    "request_context",
+    "span",
+    "snapshot",
+]
+
+
+def snapshot(
+    registry: MetricsRegistry | None = None,
+    tracer: Tracer | None = None,
+    *,
+    span_limit: int = 64,
+) -> dict:
+    """One JSON-able telemetry dump: metric values + recent spans. The bench
+    harnesses attach this next to their single JSON line so a committed
+    bench record carries the run's internal timings, not just the
+    headline."""
+    return {
+        "metrics": (registry or default_registry()).snapshot(),
+        "spans": (tracer or default_tracer()).export(limit=span_limit),
+    }

@@ -1,0 +1,201 @@
+"""Lightweight always-on spans — the Dapper-shaped third leg of telemetry.
+
+Metrics aggregate, logs narrate; spans answer "where did *this* run spend
+its time". Following Dapper's low-overhead always-on design (Sigelman et
+al., 2010) the tracer is cheap enough to leave enabled: a span is one clock
+read on entry, one on exit, and an append into a bounded ring buffer — no
+I/O, no sampling daemon. The ring holds the most recent ``capacity``
+finished spans; `export()` dumps them JSON-able for bench records, tests
+and ad-hoc inspection.
+
+- `span(name, **attrs)` — context manager. Nesting is tracked through a
+  contextvar, so child spans record their parent id without explicit
+  plumbing (and correctly across threads: each thread starts parentless
+  unless the caller propagates context).
+- The clock is injectable (`Tracer(clock=...)`), so span timing is exact
+  under fake clocks in tests.
+- When a real JAX profiler trace is being captured (`bench.py --profile`,
+  ``serve --profile-dir``), each span also enters
+  ``jax.profiler.TraceAnnotation(name)``, so the same stage names line up
+  on the TensorBoard timeline. The pass-through is best-effort: any
+  profiler import/runtime failure degrades to pure in-process spans.
+- `record_span(name, start, end)` — after-the-fact registration for code
+  that already measured a phase (the pipeline's ``tick()`` timings) so it
+  lands in the same ring with the same parent semantics.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import itertools
+import threading
+import time
+from typing import Any, Callable, Iterator
+
+__all__ = ["Span", "Tracer", "default_tracer", "span", "record_span"]
+
+
+class Span:
+    """One finished (or in-flight) timed region."""
+
+    __slots__ = (
+        "name", "span_id", "parent_id", "start_s", "end_s", "attrs",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        span_id: int,
+        parent_id: int | None,
+        start_s: float,
+        attrs: dict[str, Any],
+    ):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_s = start_s
+        self.end_s: float | None = None
+        self.attrs = attrs
+
+    @property
+    def duration_s(self) -> float | None:
+        if self.end_s is None:
+            return None
+        return self.end_s - self.start_s
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_s": round(self.start_s, 6),
+            "duration_s": (
+                None
+                if self.duration_s is None
+                else round(self.duration_s, 6)
+            ),
+        }
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        return d
+
+
+class Tracer:
+    """Span factory + bounded ring buffer of finished spans.
+
+    One default tracer per process (`default_tracer()`); tests build their
+    own with a fake clock. ``jax_annotations`` gates the
+    `jax.profiler.TraceAnnotation` pass-through (on by default; it is a
+    no-op outside an active profiler trace)."""
+
+    def __init__(
+        self,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        capacity: int = 2048,
+        jax_annotations: bool = True,
+    ):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._ring: collections.deque[Span] = collections.deque(
+            maxlen=capacity
+        )
+        self._ids = itertools.count(1)
+        self._current: contextvars.ContextVar[Span | None] = (
+            contextvars.ContextVar("cobalt_current_span", default=None)
+        )
+        self._jax_annotations = jax_annotations
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
+
+    def current(self) -> Span | None:
+        return self._current.get()
+
+    @contextlib.contextmanager
+    def _annotation(self, name: str) -> Iterator[None]:
+        if not self._jax_annotations:
+            yield
+            return
+        try:
+            import jax.profiler
+
+            cm = jax.profiler.TraceAnnotation(name)
+        except Exception:
+            cm = contextlib.nullcontext()
+        with cm:
+            yield
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[Span]:
+        """Time the block; record a finished `Span` in the ring."""
+        parent = self._current.get()
+        sp = Span(
+            name,
+            next(self._ids),
+            None if parent is None else parent.span_id,
+            self._clock(),
+            attrs,
+        )
+        token = self._current.set(sp)
+        try:
+            with self._annotation(name):
+                yield sp
+        finally:
+            sp.end_s = self._clock()
+            self._current.reset(token)
+            with self._lock:
+                self._ring.append(sp)
+
+    def record_span(
+        self,
+        name: str,
+        start_s: float,
+        end_s: float,
+        **attrs: Any,
+    ) -> Span:
+        """Register an already-measured region (parented to the span in
+        scope, if any)."""
+        parent = self._current.get()
+        sp = Span(
+            name,
+            next(self._ids),
+            None if parent is None else parent.span_id,
+            start_s,
+            attrs,
+        )
+        sp.end_s = end_s
+        with self._lock:
+            self._ring.append(sp)
+        return sp
+
+    def export(self, limit: int | None = None) -> list[dict[str, Any]]:
+        """Most recent finished spans, oldest first, JSON-able."""
+        with self._lock:
+            spans = list(self._ring)
+        if limit is not None:
+            spans = spans[-limit:]
+        return [s.to_dict() for s in spans]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+_default_tracer = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _default_tracer
+
+
+def span(name: str, **attrs: Any):
+    """``with span("pipeline.rfe", rows=n): ...`` on the default tracer."""
+    return _default_tracer.span(name, **attrs)
+
+
+def record_span(name: str, start_s: float, end_s: float, **attrs: Any) -> Span:
+    return _default_tracer.record_span(name, start_s, end_s, **attrs)

@@ -148,6 +148,18 @@ class _UploadFile:
         return self._data
 
 
+class _Request:
+    def __init__(self, headers=None):
+        self.headers = dict(headers or {})
+
+
+class _Response:
+    def __init__(self, content=None, media_type=None):
+        self.content = content
+        self.media_type = media_type
+        self.headers: dict[str, str] = {}
+
+
 @pytest.fixture
 def fastapi_stubbed(monkeypatch):
     fastapi_mod = types.ModuleType("fastapi")
@@ -155,6 +167,8 @@ def fastapi_stubbed(monkeypatch):
     fastapi_mod.HTTPException = _HTTPException
     fastapi_mod.UploadFile = _UploadFile
     fastapi_mod.File = lambda *a, **k: None
+    fastapi_mod.Request = _Request
+    fastapi_mod.Response = _Response
     pydantic_mod = types.ModuleType("pydantic")
     pydantic_mod.BaseModel = _BaseModel
     pydantic_mod.ConfigDict = dict
@@ -185,12 +199,19 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
         "/feature_importance_bulk",
         "/admin/reload",
     }
-    assert set(app.get_routes) == {"/healthz", "/readyz"}
+    assert set(app.get_routes) == {"/healthz", "/readyz", "/metrics"}
 
     # health/readiness GET routes: healthy service -> ok, shap ok, 200 path
     assert app.get_routes["/healthz"]() == {"status": "ok"}
     ready_payload = app.get_routes["/readyz"]()
     assert ready_payload["shap"] == "ok" and not ready_payload["degraded"]
+
+    # /metrics GET: valid Prometheus text over the service's registry
+    from cobalt_smart_lender_ai_tpu.telemetry import parse_exposition
+
+    scrape = app.get_routes["/metrics"]()
+    assert scrape.media_type.startswith("text/plain")
+    parse_exposition(scrape.content)
 
     # /predict happy path: the handler only needs model_dump(by_alias=True),
     # so a stand-in with the contract's two aliases drives it; the REAL

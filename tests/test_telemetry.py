@@ -1,0 +1,407 @@
+"""Telemetry layer: exposition format, spans, structured logs, propagation.
+
+Pins the contracts the observability layer promises (README "Observability"):
+
+- the Prometheus text `render()` escapes correctly, keeps labels in declared
+  order, and emits cumulative histogram buckets — round-tripped through the
+  strict `parse_exposition` CI uses against a live scrape;
+- spans nest through the contextvar parent and time exactly under an
+  injectable clock;
+- structured log lines are one JSON object carrying the in-scope request id;
+- a request id crosses the micro-batcher's thread boundary (captured at
+  submit, visible in the dispatch span);
+- ``GET /metrics`` on the stdlib adapter serves a parseable exposition with
+  route/status-labeled request latencies, and the adapter echoes
+  ``X-Request-ID``;
+- `FaultInjectingStore` counters surface through a registry.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import urllib.request
+
+import pytest
+
+from cobalt_smart_lender_ai_tpu.telemetry import (
+    EXPOSITION_CONTENT_TYPE,
+    MetricsRegistry,
+    Tracer,
+    get_logger,
+    log_buckets,
+    parse_exposition,
+    request_context,
+    snapshot,
+)
+
+# --- exposition format --------------------------------------------------------
+
+
+def test_render_roundtrips_counter_gauge_histogram():
+    reg = MetricsRegistry()
+    c = reg.counter("t_requests_total", "requests", ("route", "status"))
+    c.labels(route="/predict", status="200").inc()
+    c.labels(route="/predict", status="200").inc(2)
+    reg.gauge("t_depth", "queue depth").set(3)
+    h = reg.histogram("t_latency_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+
+    out = parse_exposition(reg.render())
+    assert out["t_requests_total"]["type"] == "counter"
+    assert out["t_depth"]["type"] == "gauge"
+    assert out["t_latency_seconds"]["type"] == "histogram"
+    samples = out["t_requests_total"]["samples"]
+    assert samples == {"t_requests_total|route=/predict|status=200": 3.0}
+    assert out["t_depth"]["samples"] == {"t_depth": 3.0}
+
+
+def test_label_value_escaping_roundtrips():
+    """Backslash, double-quote and newline in a label value must survive
+    render -> parse unchanged — the three characters the format escapes."""
+    nasty = 'a\\b"c\nd'
+    reg = MetricsRegistry()
+    reg.counter("t_esc_total", 'help with "quotes", \\ and\nnewline', ("k",)).labels(
+        k=nasty
+    ).inc()
+    text = reg.render()
+    assert '\\\\' in text and '\\"' in text and "\\n" in text
+    out = parse_exposition(text)
+    assert out["t_esc_total"]["samples"] == {f"t_esc_total|k={nasty}": 1.0}
+
+
+def test_labels_render_in_declared_order_not_alphabetical():
+    reg = MetricsRegistry()
+    reg.counter("t_order_total", "order", ("zeta", "alpha")).labels(
+        zeta="z", alpha="a"
+    ).inc()
+    line = [
+        ln for ln in reg.render().splitlines() if ln.startswith("t_order_total{")
+    ][0]
+    assert line == 't_order_total{zeta="z",alpha="a"} 1'
+
+
+def test_histogram_buckets_are_cumulative_with_inf_sum_count():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_h_seconds", "h", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 100.0):
+        h.observe(v)
+
+    cum = h._solo().cumulative()
+    assert cum == [(1.0, 1), (2.0, 2), (4.0, 3), (math.inf, 4)]
+
+    out = parse_exposition(reg.render())
+    samples = out["t_h_seconds"]["samples"]
+
+    def bucket(le: str) -> float:
+        return samples[f"t_h_seconds_bucket|le={le}"]
+
+    assert [bucket(le) for le in ("1", "2", "4", "+Inf")] == [1, 2, 3, 4]
+    assert samples["t_h_seconds_count"] == 4
+    assert samples["t_h_seconds_sum"] == pytest.approx(105.0)
+    # +Inf bucket == _count: the invariant scrapers aggregate on
+    assert bucket("+Inf") == samples["t_h_seconds_count"]
+
+
+def test_registry_get_or_create_and_conflicts():
+    reg = MetricsRegistry()
+    a = reg.counter("t_same_total", "x", ("op",))
+    assert reg.counter("t_same_total", "x", ("op",)) is a
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("t_same_total", "x", ("op",))  # kind conflict
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("t_same_total", "x", ("other",))  # labelname conflict
+    with pytest.raises(ValueError):
+        a.labels(op="get").inc(-1)  # counters only go up
+    with pytest.raises(ValueError):
+        reg.counter("0bad", "x")  # invalid metric name
+
+
+def test_collect_callback_failure_degrades_to_nan_not_crash():
+    reg = MetricsRegistry()
+    g = reg.gauge("t_live", "sampled at collect time")
+
+    def dead():
+        raise LookupError("source object is gone")
+
+    g.set_function(dead)
+    assert math.isnan(g.value)
+    out = parse_exposition(reg.render())  # a dead callback must not kill scrape
+    assert math.isnan(out["t_live"]["samples"]["t_live"])
+
+
+def test_log_buckets_geometric_and_bounded():
+    b = log_buckets(1e-3, 10.0, per_decade=2)
+    assert b[0] == pytest.approx(1e-3)
+    assert b[-1] >= 10.0
+    assert list(b) == sorted(b)
+    assert len(set(b)) == len(b)
+    with pytest.raises(ValueError):
+        log_buckets(1.0, 0.5)
+
+
+# --- spans under an injectable clock ------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def test_span_nesting_and_exact_durations_under_fake_clock():
+    clk = FakeClock()
+    tr = Tracer(clock=clk, jax_annotations=False)
+    with tr.span("outer", stage="fit") as outer:
+        clk.now += 1.0
+        with tr.span("inner") as inner:
+            clk.now += 0.25
+        clk.now += 0.5
+    spans = tr.export()
+    assert [s["name"] for s in spans] == ["inner", "outer"]  # finish order
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["outer"]["parent_id"] is None
+    assert by_name["inner"]["duration_s"] == pytest.approx(0.25)
+    assert by_name["outer"]["duration_s"] == pytest.approx(1.75)
+    assert by_name["outer"]["attrs"] == {"stage": "fit"}
+    assert outer.span_id != inner.span_id
+
+
+def test_record_span_parents_under_open_span_and_ring_bounds():
+    clk = FakeClock()
+    tr = Tracer(clock=clk, capacity=4, jax_annotations=False)
+    with tr.span("pipeline.run") as root:
+        tr.record_span("pipeline.clean", 100.0, 101.5, rows=10)
+    spans = {s["name"]: s for s in tr.export()}
+    assert spans["pipeline.clean"]["parent_id"] == root.span_id
+    assert spans["pipeline.clean"]["duration_s"] == pytest.approx(1.5)
+    # ring keeps only the most recent `capacity` spans
+    for i in range(10):
+        tr.record_span(f"s{i}", 0.0, 1.0)
+    assert len(tr.export()) == 4
+    assert [s["name"] for s in tr.export()] == ["s6", "s7", "s8", "s9"]
+    assert len(tr.export(limit=2)) == 2
+    tr.clear()
+    assert tr.export() == []
+    # the whole export must be JSON-able (bench records embed it)
+    json.dumps(snapshot(MetricsRegistry(), tr))
+
+
+# --- structured logs ----------------------------------------------------------
+
+
+def test_structured_log_is_json_and_carries_request_id(caplog):
+    log = get_logger("test.telemetry")
+    assert log.stdlib.name == "cobalt.test.telemetry"
+    with caplog.at_level(logging.INFO, logger="cobalt.test.telemetry"):
+        with request_context("req-abc-123") as rid:
+            assert rid == "req-abc-123"
+            log.info("scored", route="/predict", status=200)
+        log.warning("drained")  # outside the context: no request_id key
+    first = json.loads(caplog.records[0].getMessage())
+    assert first["event"] == "scored"
+    assert first["request_id"] == "req-abc-123"
+    assert first["route"] == "/predict" and first["status"] == 200
+    assert first["level"] == "INFO" and "ts" in first
+    second = json.loads(caplog.records[1].getMessage())
+    assert "request_id" not in second
+    assert second["level"] == "WARNING"
+
+
+def test_request_context_mints_id_when_client_sent_none():
+    with request_context() as rid:
+        assert isinstance(rid, str) and len(rid) == 16
+        with request_context("outer-wins-not") as inner:
+            assert inner == "outer-wins-not"
+        from cobalt_smart_lender_ai_tpu.telemetry import current_request_id
+
+        assert current_request_id() == rid
+
+
+# --- request-id propagation through the micro-batcher -------------------------
+
+
+def _payload(seed: float = 1.5) -> dict:
+    from cobalt_smart_lender_ai_tpu.data import schema
+    from cobalt_smart_lender_ai_tpu.serve.service import SINGLE_INPUT_FIELDS
+
+    return {
+        canonical: 1 if canonical in schema.SERVING_INT_FEATURES else seed
+        for canonical in SINGLE_INPUT_FIELDS.values()
+    }
+
+
+def _cfg(**kw):
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+
+    kw.setdefault("precompile_batch_buckets", ())
+    kw.setdefault("microbatch_max_wait_ms", 25.0)
+    return ServeConfig(**kw)
+
+
+def test_request_ids_cross_the_batcher_thread_boundary(serving_artifact):
+    """Two requests submitted under distinct request contexts coalesce into
+    one dispatch; the dispatch span (recorded on the worker thread, where
+    neither context is live) carries BOTH ids — the submit-time capture."""
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+    from cobalt_smart_lender_ai_tpu.telemetry import default_tracer
+
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store, _cfg(microbatch_max_rows=2))
+    default_tracer().clear()
+    rids = ("rid-aaaa", "rid-bbbb")
+
+    def client(i: int) -> None:
+        with request_context(rids[i]):
+            svc.predict_single(_payload(seed=0.5 * (i + 1)))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(2)]
+    with svc.batcher.pause():
+        for t in threads:
+            t.start()
+        deadline = threading.Event()
+        for _ in range(5000):
+            if svc.batcher.queue_depth() == 2:
+                break
+            deadline.wait(0.002)
+        assert svc.batcher.queue_depth() == 2
+    for t in threads:
+        t.join(timeout=30.0)
+
+    dispatches = [
+        s
+        for s in default_tracer().export()
+        if s["name"] == "serve.microbatch_dispatch"
+        and set(s.get("attrs", {}).get("request_ids", ())) == set(rids)
+    ]
+    assert dispatches, "no dispatch span carried both submitted request ids"
+    assert dispatches[0]["attrs"]["rows"] == 2
+    svc.close()
+
+
+# --- stdlib adapter: /metrics + X-Request-ID ----------------------------------
+
+
+@pytest.fixture()
+def telemetry_http(serving_artifact):
+    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store, _cfg())
+    httpd = make_server(svc, "127.0.0.1", 0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", svc
+    httpd.shutdown()
+    svc.close()
+
+
+def _request(url, body=None, headers=None):
+    req = urllib.request.Request(
+        url,
+        data=body,
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST" if body is not None else "GET",
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_metrics_endpoint_serves_labeled_latencies(telemetry_http):
+    base, svc = telemetry_http
+    status, headers, _ = _request(
+        base + "/predict",
+        json.dumps(_payload()).encode(),
+        headers={"X-Request-ID": "client-chose-this"},
+    )
+    assert status == 200
+    # the adapter honors and echoes the client's id (correlatable reports)
+    assert headers["X-Request-ID"] == "client-chose-this"
+    status, headers, _ = _request(base + "/predict", b"{}")
+    assert status == 422
+    assert len(headers["X-Request-ID"]) == 16  # minted when absent
+
+    status, headers, body = _request(base + "/metrics")
+    assert status == 200
+    assert headers["Content-Type"] == EXPOSITION_CONTENT_TYPE
+    out = parse_exposition(body.decode())  # must be valid text format
+
+    lat = out["cobalt_request_latency_seconds"]["samples"]
+    ok = lat["cobalt_request_latency_seconds_count|route=/predict|status=200"]
+    bad = lat["cobalt_request_latency_seconds_count|route=/predict|status=422"]
+    assert ok >= 1 and bad >= 1
+    errs = out["cobalt_request_errors_total"]["samples"]
+    assert (
+        errs["cobalt_request_errors_total|code=invalid_input|route=/predict"]
+        >= 1
+    )
+    # the microbatch instruments are registered on the same registry
+    assert "cobalt_microbatch_batch_rows" in out
+    assert "cobalt_admission_in_flight" in out
+    assert "cobalt_breaker_state" in out
+    # and the scrape itself was recorded by the middleware on the next scrape
+    status, _, body = _request(base + "/metrics")
+    out2 = parse_exposition(body.decode())
+    assert (
+        out2["cobalt_request_latency_seconds"]["samples"][
+            "cobalt_request_latency_seconds_count|route=/metrics|status=200"
+        ]
+        >= 1
+    )
+
+
+def test_unknown_paths_fold_into_one_route_label(telemetry_http):
+    base, svc = telemetry_http
+    for probe in ("/nope", "/admin/../etc", "/predict2"):
+        status, _, _ = _request(base + probe, b"{}")
+        assert status == 404
+    text = svc.registry.render()
+    assert 'route="unmatched"' in text
+    for probe in ("/nope", "/predict2"):
+        assert f'route="{probe}"' not in text  # cardinality stays bounded
+
+
+# --- fault-store counters through a registry ----------------------------------
+
+
+def test_fault_store_counters_surface_in_registry(tmp_path):
+    from cobalt_smart_lender_ai_tpu.io import ObjectStore
+    from cobalt_smart_lender_ai_tpu.reliability import (
+        FaultInjectingStore,
+        FaultSpec,
+    )
+
+    reg = MetricsRegistry()
+    store = FaultInjectingStore(
+        ObjectStore(str(tmp_path / "lake")),
+        seed=3,
+        faults={"get": FaultSpec(fail_after=1, max_faults=2)},
+        registry=reg,
+    )
+    store.put_bytes("k", b"v")
+    assert store.get_bytes("k") == b"v"
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            store.get_bytes("k")
+    assert store.get_bytes("k") == b"v"  # budget spent: calls run clean
+
+    out = parse_exposition(reg.render())
+
+    def sample(name: str, op: str) -> float:
+        return out[name]["samples"][f"{name}|op={op}"]
+
+    assert sample("cobalt_store_fault_calls_total", "get") == 4
+    assert sample("cobalt_store_fault_calls_total", "put") == 1
+    assert sample("cobalt_store_faults_injected_total", "get") == 2
+    assert sample("cobalt_store_faults_injected_total", "put") == 0
+    # the registry mirrors, it does not own: the store stays single writer
+    assert store.calls["get"] == 4 and store.injected["get"] == 2
