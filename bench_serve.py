@@ -167,6 +167,7 @@ def run_load(
         "p50_ms": round(_percentile(singles, 0.50), 3),
         "p95_ms": round(_percentile(singles, 0.95), 3),
         "p99_ms": round(_percentile(singles, 0.99), 3),
+        "p99.9_ms": round(_percentile(singles, 0.999), 3),
         "max_ms": round(singles[-1], 3) if singles else float("nan"),
         "mean_ms": round(statistics.fmean(singles), 3) if singles else float("nan"),
     }
@@ -176,7 +177,35 @@ def run_load(
         result["bulk_p95_ms"] = round(_percentile(bulks, 0.95), 3)
     if service.batcher is not None:
         result["microbatch"] = service.batcher.stats()
+    phases = _phase_breakdown(service.registry)
+    if phases:
+        result["phases"] = phases
     return result
+
+
+def _phase_breakdown(registry) -> dict[str, dict]:
+    """Where the time went, per request phase, from the
+    ``cobalt_request_phase_seconds`` histogram the service populates on
+    every `predict_single` — the bench-record answer to "queue-wait or
+    dispatch or SHAP?". Includes warmup traffic (cumulative counters), so
+    cold compiles show up in the phase that paid them."""
+    fam = registry.snapshot().get("cobalt_request_phase_seconds")
+    if not fam:
+        return {}
+    out: dict[str, dict] = {}
+    total_s = sum(s["sum"] for s in fam["samples"]) or 1.0
+    for sample in fam["samples"]:
+        phase = sample["labels"].get("phase", "?")
+        count = sample["count"]
+        if not count:
+            continue
+        out[phase] = {
+            "count": count,
+            "mean_ms": round(sample["sum"] / count * 1e3, 3),
+            "total_ms": round(sample["sum"] * 1e3, 1),
+            "share": round(sample["sum"] / total_s, 3),
+        }
+    return out
 
 
 def run_http_smoke(
@@ -232,10 +261,10 @@ def run_http_smoke(
             i += 1
         conn.close()
 
-    def scrape() -> tuple[str, str]:
+    def scrape(path: str = "/metrics", accept: str | None = None) -> tuple[str, str]:
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
         try:
-            conn.request("GET", "/metrics")
+            conn.request("GET", path, headers={"Accept": accept} if accept else {})
             resp = conn.getresponse()
             text = resp.read().decode()
             return text, resp.getheader("Content-Type") or ""
@@ -258,6 +287,12 @@ def run_http_smoke(
             t.join()
         final_text, _ = scrape()
         families = parse_exposition(final_text)
+        # the OpenMetrics variant (exemplar trace ids on latency buckets)
+        # must parse through the same strict parser
+        om_text, om_ctype = scrape(accept="application/openmetrics-text")
+        parse_exposition(om_text)
+        slo_report = json.loads(scrape("/slo")[0])
+        slowest = json.loads(scrape("/debug/slowest?k=3")[0])
     finally:
         httpd.shutdown()
         httpd.server_close()
@@ -275,13 +310,31 @@ def run_http_smoke(
         for k, v in batch_rows["samples"].items()
         if k.startswith("cobalt_microbatch_batch_rows_count")
     )
+    top = (slowest.get("slowest") or [{}])[0]
+    top_phases = top.get("phases_ms") or {}
     return {
         "requests": sum(requests),
         "errors": sum(errors),
         "families": len(families),
         "scrape_during_load_ok": bool(during_ctype.startswith("text/plain")),
+        "openmetrics_ok": bool(
+            om_ctype.startswith("application/openmetrics-text")
+            and om_text.rstrip().endswith("# EOF")
+        ),
         "request_latency_count": int(latency_count),
         "microbatch_batch_count": int(batch_count),
+        # SLO + flight-recorder forensics over real sockets — CI fails the
+        # build on fast_burn and keeps the slowest request's phase verdict
+        # in the committed record
+        "slo_fast_burn": bool(slo_report.get("fast_burn")),
+        "slo_burn_rates": {
+            o["name"]: o["windows"][0]["burn_rate"]
+            for o in slo_report.get("objectives", [])
+        },
+        "slowest_ms": top.get("duration_ms"),
+        "slowest_phase": (
+            max(top_phases, key=top_phases.get) if top_phases else None
+        ),
     }
 
 
@@ -304,6 +357,10 @@ def main(argv: list[str] | None = None) -> int:
                         "result lands under record['metrics_scrape'])")
     parser.add_argument("--out", default=None,
                         help="also write the JSON line to this path")
+    parser.add_argument("--trace-out", default=None,
+                        help="write the run's span ring as Chrome Trace "
+                        "Event / Perfetto JSON to this path (open in "
+                        "ui.perfetto.dev; CI uploads it as an artifact)")
     args = parser.parse_args(argv)
     if args.smoke:
         args.clients = min(args.clients, 4)
@@ -376,8 +433,15 @@ def main(argv: list[str] | None = None) -> int:
             "sockets, scraping /metrics...",
             file=sys.stderr,
         )
+        # SLO thresholds are CI-noise-proof here: shared runners hiccup, and
+        # the gate below is "no fast burn", not the production 10ms target
         record_scrape = run_http_smoke(
-            ServeConfig(microbatch_enabled=True, **mb_kwargs),
+            ServeConfig(
+                microbatch_enabled=True,
+                slo_p99_ms=250.0,
+                slo_p999_ms=2000.0,
+                **mb_kwargs,
+            ),
             artifact,
             payloads,
             clients=min(args.clients, 4),
@@ -405,6 +469,16 @@ def main(argv: list[str] | None = None) -> int:
     if args.out:
         with open(args.out, "w") as fh:
             fh.write(line + "\n")
+    if args.trace_out:
+        from cobalt_smart_lender_ai_tpu.telemetry import (
+            default_tracer,
+            render_chrome_trace,
+        )
+
+        with open(args.trace_out, "w") as fh:
+            fh.write(render_chrome_trace(default_tracer()))
+        print(f"[bench] perfetto trace written to {args.trace_out}",
+              file=sys.stderr)
     return 0
 
 

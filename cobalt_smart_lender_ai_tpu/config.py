@@ -293,6 +293,29 @@ class ServeConfig:
     #: Most rows coalesced into one batch; arrivals beyond it dispatch
     #: immediately. Effectively capped at ``max_batch_rows``.
     microbatch_max_rows: int = 64
+    #: Warm EVERY power-of-two bucket the micro-batcher can emit (1 .. its
+    #: cap), margin and SHAP, at model build — not just the cap bucket — so
+    #: a stray first-hit compile can never pollute the tail mid-traffic
+    #: (BENCH_SERVE_r01's 611 ms max; ROADMAP "Tail latency"). Costs
+    #: log2(cap) extra compiles at startup/hot-swap; tests that build many
+    #: services turn it off.
+    prewarm_all_buckets: bool = True
+    #: Flight recorder (telemetry.flight, served at ``GET /debug/*``):
+    #: ring capacity, the always-capture slow threshold, and the size of
+    #: the top-K-by-latency board.
+    flight_capacity: int = 256
+    flight_slow_threshold_ms: float = 100.0
+    flight_top_k: int = 32
+    #: SLO engine (telemetry.slo, served at ``GET /slo`` and as
+    #: ``cobalt_slo_*`` gauges). Latency thresholds are snapped down to the
+    #: nearest histogram bucket bound at evaluation (reported per
+    #: objective); availability counts HTTP 5xx as bad.
+    slo_enabled: bool = True
+    slo_p99_ms: float = 10.0
+    slo_p999_ms: float = 100.0
+    slo_availability_target: float = 0.999
+    slo_windows_s: tuple[float, ...] = (60.0, 3600.0)
+    slo_fast_burn_threshold: float = 14.4
     reliability: ReliabilityConfig = dataclasses.field(
         default_factory=ReliabilityConfig
     )

@@ -468,6 +468,12 @@ def main(argv=None) -> PipelineResult:
         "instead of the reference's full 20x3 protocol, for demos and smoke "
         "runs; quality lands in the same AUC regime",
     )
+    parser.add_argument(
+        "--trace-out",
+        default=None,
+        help="write the run's stage spans as Chrome Trace Event / Perfetto "
+        "JSON to this path (open in ui.perfetto.dev)",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -502,6 +508,17 @@ def main(argv=None) -> PipelineResult:
         raw = synthetic_lendingclub_frame(args.synthetic_rows, seed=args.seed)
     store = ObjectStore(args.store) if args.store else None
     result = run_pipeline(cfg, raw=raw, store=store, resume=args.resume)
+    if args.trace_out:
+        from cobalt_smart_lender_ai_tpu.telemetry import (
+            default_tracer,
+            render_chrome_trace,
+        )
+
+        with open(args.trace_out, "w") as fh:
+            fh.write(render_chrome_trace(default_tracer()))
+        logging.getLogger(__name__).info(
+            "perfetto trace written to %s", args.trace_out
+        )
     print(
         {
             "test_auc": result.test_auc,

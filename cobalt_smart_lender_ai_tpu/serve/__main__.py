@@ -47,6 +47,20 @@ def main() -> None:
         help="dispatch early once this many requests are queued",
     )
     parser.add_argument(
+        "--no-prewarm",
+        action="store_true",
+        help="compile only the largest micro-batch bucket at startup instead "
+        "of every power-of-two bucket (faster start, cold-compile tail "
+        "spikes on first hit of each smaller bucket)",
+    )
+    parser.add_argument(
+        "--flight-slow-ms",
+        type=float,
+        default=ServeConfig.flight_slow_threshold_ms,
+        help="requests at or over this wall time are always captured by the "
+        "flight recorder (GET /debug/slowest names the slow phase)",
+    )
+    parser.add_argument(
         "--profile-dir",
         default=None,
         help="capture a jax.profiler trace of the whole serving session "
@@ -70,13 +84,19 @@ def main() -> None:
         microbatch_enabled=not args.no_microbatch,
         microbatch_max_wait_ms=args.microbatch_wait_ms,
         microbatch_max_rows=args.microbatch_max_rows,
+        prewarm_all_buckets=not args.no_prewarm,
+        flight_slow_threshold_ms=args.flight_slow_ms,
     )
     service = ScorerService.from_store(ObjectStore(args.store), cfg)
     print(f"[INFO] model restored from {args.store}/{cfg.model_key}; "
           f"{len(service.feature_names)} features")
     if service.batcher is not None:
         print(f"[INFO] micro-batching on: wait {cfg.microbatch_max_wait_ms}ms, "
-              f"max {cfg.microbatch_max_rows} rows/dispatch")
+              f"max {cfg.microbatch_max_rows} rows/dispatch"
+              + ("" if args.no_prewarm else "; all buckets pre-warmed"))
+    print("[INFO] tail-latency forensics: GET /debug/requests, "
+          "/debug/slowest, /debug/trace (Perfetto), /slo "
+          f"(slow threshold {cfg.flight_slow_threshold_ms:g}ms)")
 
     if args.profile_dir:
         print(f"[INFO] profiler trace capturing to {args.profile_dir}")

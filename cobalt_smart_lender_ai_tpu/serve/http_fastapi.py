@@ -23,7 +23,7 @@ ASGI middleware, so it also executes under the in-repo stub harness
 
 from __future__ import annotations
 
-import time
+import json
 from typing import Any, Dict, List, Optional
 
 from cobalt_smart_lender_ai_tpu.config import ServeConfig
@@ -37,6 +37,12 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
 from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 from cobalt_smart_lender_ai_tpu.telemetry import (
     EXPOSITION_CONTENT_TYPE,
+    META_ROUTES,
+    OPENMETRICS_CONTENT_TYPE,
+    TRACE_CONTENT_TYPE,
+    chrome_trace,
+    collect_phases,
+    default_tracer,
     get_logger,
     request_context,
 )
@@ -131,34 +137,53 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
         raise http_exc
 
     @contextmanager
-    def _track(route: str, request, response):
+    def _track(route: str, request, response, method: str = "POST"):
         """Per-request telemetry envelope (see module docstring). `request`
         and `response` are None under the stub harness, which calls the
-        handlers directly — the envelope still times, counts and logs."""
+        handlers directly — the envelope still times, counts, flight-records
+        and logs. Mirrors `http_stdlib._handle`: the root ``http.request``
+        span's id is the request's trace id (log lines, flight record,
+        ``GET /debug/trace``, latency-histogram exemplar all join on it)."""
         rid_header = None
         if request is not None:
             headers = getattr(request, "headers", None)
             if headers is not None:
                 rid_header = headers.get("X-Request-ID")
-        t0 = time.monotonic()
         with request_context(rid_header or None) as rid:
             if response is not None:
                 response.headers["X-Request-ID"] = rid
             status, code = 200, None
             try:
-                yield
-            except HTTPException as e:
-                status = e.status_code
-                code = getattr(e, "cobalt_code", None)
-                raise
-            except Exception:
-                status, code = 500, "internal"
-                raise
+                with collect_phases() as phases, default_tracer().span(
+                    "http.request", route=route, method=method, request_id=rid
+                ) as root:
+                    try:
+                        yield
+                    except HTTPException as e:
+                        status = e.status_code
+                        code = getattr(e, "cobalt_code", None)
+                        raise
+                    except Exception:
+                        status, code = 500, "internal"
+                        raise
             finally:
-                duration_s = time.monotonic() - t0
-                state["service"].observe_request(
-                    route, status, duration_s, code=code
+                duration_s = root.duration_s or 0.0
+                service_obj = state["service"]
+                service_obj.observe_request(
+                    route, status, duration_s, code=code,
+                    trace_id=root.trace_id,
                 )
+                if route not in META_ROUTES:
+                    service_obj.flight.record(
+                        request_id=rid,
+                        trace_id=root.trace_id,
+                        route=route,
+                        method=method,
+                        status=status,
+                        duration_s=duration_s,
+                        code=code,
+                        phases=phases.phases,
+                    )
                 if status >= 400:
                     _LOG.warning(
                         "request_error",
@@ -166,6 +191,8 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
                         status=status,
                         code=code or "error",
                         duration_ms=round(duration_s * 1000.0, 3),
+                        trace_id=root.trace_id,
+                        span_id=root.span_id,
                     )
 
     @app.post("/predict")
@@ -253,10 +280,52 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
         return payload
 
     @app.get("/metrics")
-    def metrics():
+    def metrics(request: Request = None):
+        # content negotiation: the OpenMetrics variant carries exemplar
+        # trace ids on latency buckets; the classic 0.0.4 format (the
+        # default) stays byte-identical for strict parsers
+        accept = ""
+        if request is not None:
+            headers = getattr(request, "headers", None)
+            if headers is not None:
+                accept = headers.get("Accept") or ""
+        openmetrics = "application/openmetrics-text" in accept
         return Response(
-            content=state["service"].registry.render(),
-            media_type=EXPOSITION_CONTENT_TYPE,
+            content=state["service"].registry.render(openmetrics=openmetrics),
+            media_type=OPENMETRICS_CONTENT_TYPE
+            if openmetrics
+            else EXPOSITION_CONTENT_TYPE,
+        )
+
+    @app.get("/slo")
+    def slo():
+        svc = state["service"]
+        if svc.slo is None:
+            raise HTTPException(status_code=404, detail="SLO engine disabled")
+        return svc.slo.evaluate(force=True)
+
+    @app.get("/debug/requests")
+    def debug_requests(n: int = 50):
+        flight = state["service"].flight
+        return {
+            "recent": flight.records(n),
+            "errors": flight.errors(n),
+            "stats": flight.stats(),
+        }
+
+    @app.get("/debug/slowest")
+    def debug_slowest(k: int = 0):
+        flight = state["service"].flight
+        return {
+            "slowest": flight.slowest(k or flight.top_k),
+            "stats": flight.stats(),
+        }
+
+    @app.get("/debug/trace")
+    def debug_trace():
+        return Response(
+            content=json.dumps(chrome_trace(default_tracer())),
+            media_type=TRACE_CONTENT_TYPE,
         )
 
     return app

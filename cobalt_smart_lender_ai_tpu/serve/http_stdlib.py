@@ -13,7 +13,13 @@ match the reference:
 - ``POST /feature_importance_bulk`` — JSON ``{"data": [...]}``, 400 if empty
 - ``POST /admin/reload``          — hot model swap (optional ``model_key``)
 - ``GET /metrics``                — Prometheus text exposition of
-  ``service.registry`` (README "Observability")
+  ``service.registry`` (README "Observability"); with ``Accept:
+  application/openmetrics-text`` the latency buckets carry exemplar
+  trace ids
+- ``GET /slo``                    — SLO burn-rate report (telemetry.slo)
+- ``GET /debug/requests``         — recent flight records (``?n=``)
+- ``GET /debug/slowest``          — top-K requests by wall time (``?k=``)
+- ``GET /debug/trace``            — span ring as Chrome-trace/Perfetto JSON
 
 Errors return ``{"detail": ...}`` like FastAPI's HTTPException, plus a stable
 machine-readable ``"error"`` code from `reliability.errors` — the taxonomy
@@ -37,8 +43,8 @@ from __future__ import annotations
 import email.parser
 import email.policy
 import json
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 from cobalt_smart_lender_ai_tpu.reliability.errors import (
     RequestError,
@@ -48,7 +54,13 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
 from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 from cobalt_smart_lender_ai_tpu.telemetry import (
     EXPOSITION_CONTENT_TYPE,
+    META_ROUTES,
+    OPENMETRICS_CONTENT_TYPE,
+    TRACE_CONTENT_TYPE,
+    collect_phases,
+    default_tracer,
     get_logger,
+    render_chrome_trace,
     request_context,
 )
 
@@ -65,6 +77,10 @@ _KNOWN_ROUTES = frozenset(
         "/healthz",
         "/readyz",
         "/metrics",
+        "/slo",
+        "/debug/requests",
+        "/debug/slowest",
+        "/debug/trace",
     }
 )
 
@@ -114,9 +130,17 @@ def make_handler(service: ScorerService):
         def _send(self, code: int, obj, headers: dict | None = None) -> None:
             if code >= 400 and isinstance(obj, dict):
                 self._error_code = obj.get("error")
-            self._send_bytes(
-                code, json.dumps(obj).encode(), "application/json", headers
-            )
+            if getattr(self, "_route_path", None) in META_ROUTES:
+                self._send_bytes(
+                    code, json.dumps(obj).encode(), "application/json", headers
+                )
+                return
+            # data-plane responses: encoding + socket write is the
+            # "serialize" phase of the flight record's breakdown
+            with service.phase("serialize"):
+                self._send_bytes(
+                    code, json.dumps(obj).encode(), "application/json", headers
+                )
 
         def _json_body(self, body: bytes):
             try:
@@ -128,38 +152,69 @@ def make_handler(service: ScorerService):
 
         def _handle(self, method: str) -> None:
             """Per-request envelope shared by GET and POST: request-id
-            context, typed-error mapping, latency observation, structured
+            context, a root ``http.request`` span (whose id is the
+            request's trace id — stamped on log lines, carried by the
+            flight record, resolvable at ``GET /debug/trace``, attached to
+            the latency histogram as an OpenMetrics exemplar), typed-error
+            mapping, latency observation, flight recording, structured
             error log."""
-            route = self.path if self.path in _KNOWN_ROUTES else "unmatched"
+            split = urlsplit(self.path)
+            self._route_path = split.path
+            self._query = parse_qs(split.query)
+            route = (
+                self._route_path
+                if self._route_path in _KNOWN_ROUTES
+                else "unmatched"
+            )
             self._status: int | None = None
             self._error_code: str | None = None
             self._request_id: str | None = None
-            t0 = time.monotonic()
             with request_context(
                 self.headers.get("X-Request-ID") or None
             ) as rid:
                 self._request_id = rid
-                try:
-                    if method == "POST":
-                        self._post()
-                    else:
-                        self._get()
-                except RequestError as e:
-                    self._send(*error_response(e))
-                except Exception as e:  # pragma: no cover
-                    self._send(
-                        500,
-                        {
-                            "detail": f"Internal server error: {e}",
-                            "error": "internal",
-                        },
-                    )
-                duration_s = time.monotonic() - t0
+                with collect_phases() as phases, default_tracer().span(
+                    "http.request", route=route, method=method, request_id=rid
+                ) as root:
+                    try:
+                        if method == "POST":
+                            self._post()
+                        else:
+                            self._get()
+                    except RequestError as e:
+                        self._send(*error_response(e))
+                    except Exception as e:  # pragma: no cover
+                        self._send(
+                            500,
+                            {
+                                "detail": f"Internal server error: {e}",
+                                "error": "internal",
+                            },
+                        )
+                duration_s = root.duration_s or 0.0
                 status = self._status if self._status is not None else 500
                 service.observe_request(
-                    route, status, duration_s, code=self._error_code
+                    route,
+                    status,
+                    duration_s,
+                    code=self._error_code,
+                    trace_id=root.trace_id,
                 )
+                if route not in META_ROUTES:
+                    # the observability plane is not flight-recorded: a
+                    # scraper must not evict the data-plane records
+                    service.flight.record(
+                        request_id=rid,
+                        trace_id=root.trace_id,
+                        route=route,
+                        method=method,
+                        status=status,
+                        duration_s=duration_s,
+                        code=self._error_code,
+                        phases=phases.phases,
+                    )
                 if status >= 400:
+                    # the root span is closed here; stamp its ids explicitly
                     _LOG.warning(
                         "request_error",
                         method=method,
@@ -167,6 +222,8 @@ def make_handler(service: ScorerService):
                         status=status,
                         code=self._error_code or "error",
                         duration_ms=round(duration_s * 1000.0, 3),
+                        trace_id=root.trace_id,
+                        span_id=root.span_id,
                     )
 
         def do_POST(self):  # noqa: N802 - http.server API
@@ -180,18 +237,18 @@ def make_handler(service: ScorerService):
         def _post(self) -> None:
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)
-            if self.path == "/admin/reload":
+            if self._route_path == "/admin/reload":
                 # Admin plane: never gated by scoring admission — an
                 # operator must be able to swap in a fixed model while the
                 # data plane is shedding.
                 self._admin_reload(body)
                 return
-            if self.path == "/predict":
+            if self._route_path == "/predict":
                 with service.admission.admit():
                     self._send(
                         200, service.predict_single(self._json_body(body))
                     )
-            elif self.path == "/predict_bulk_csv":
+            elif self._route_path == "/predict_bulk_csv":
                 with service.admission.admit():
                     try:
                         csv_bytes = _extract_csv(
@@ -210,7 +267,7 @@ def make_handler(service: ScorerService):
                                 "error": "bulk_failed",
                             },
                         )
-            elif self.path == "/feature_importance_bulk":
+            elif self._route_path == "/feature_importance_bulk":
                 with service.admission.admit():
                     payload = self._json_body(body)  # malformed JSON -> 422
                     try:
@@ -244,19 +301,68 @@ def make_handler(service: ScorerService):
                     },
                 )
 
+        def _query_int(self, name: str, default: int) -> int:
+            raw = self._query.get(name, [None])[-1]
+            if raw is None:
+                return default
+            try:
+                return int(raw)
+            except ValueError:
+                raise ValidationError(f"query param {name!r} must be an integer")
+
         def _get(self) -> None:
-            if self.path == "/healthz":
+            path = self._route_path
+            if path == "/healthz":
                 self._send(200, service.health())
-            elif self.path == "/readyz":
+            elif path == "/readyz":
                 ready, payload = service.ready()
                 # degraded-but-scorable is still 200: readiness gates traffic
                 # on the probability contract, not the SHAP enrichment
                 self._send(200 if ready else 503, payload)
-            elif self.path == "/metrics":
+            elif path == "/metrics":
+                # content negotiation: the OpenMetrics variant carries
+                # exemplar trace ids on latency buckets; the classic 0.0.4
+                # format (the default, what CI's strict parser pins) does not
+                accept = self.headers.get("Accept", "")
+                openmetrics = "application/openmetrics-text" in accept
                 self._send_bytes(
                     200,
-                    service.registry.render().encode(),
-                    EXPOSITION_CONTENT_TYPE,
+                    service.registry.render(openmetrics=openmetrics).encode(),
+                    OPENMETRICS_CONTENT_TYPE
+                    if openmetrics
+                    else EXPOSITION_CONTENT_TYPE,
+                )
+            elif path == "/slo":
+                if service.slo is None:
+                    self._send(
+                        404, {"detail": "SLO engine disabled", "error": "slo_disabled"}
+                    )
+                else:
+                    self._send(200, service.slo.evaluate(force=True))
+            elif path == "/debug/requests":
+                n = self._query_int("n", 50)
+                self._send(
+                    200,
+                    {
+                        "recent": service.flight.records(n),
+                        "errors": service.flight.errors(n),
+                        "stats": service.flight.stats(),
+                    },
+                )
+            elif path == "/debug/slowest":
+                k = self._query_int("k", service.flight.top_k)
+                self._send(
+                    200,
+                    {
+                        "slowest": service.flight.slowest(k),
+                        "stats": service.flight.stats(),
+                    },
+                )
+            elif path == "/debug/trace":
+                self._send_bytes(
+                    200,
+                    render_chrome_trace(default_tracer()).encode(),
+                    TRACE_CONTENT_TYPE,
                 )
             else:
                 self._send(404, {"detail": "Not Found"})

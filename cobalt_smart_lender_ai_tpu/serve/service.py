@@ -74,8 +74,12 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
     ValidationError,
 )
 from cobalt_smart_lender_ai_tpu.telemetry import (
+    FlightRecorder,
     MetricsRegistry,
+    SLOEngine,
+    add_phase,
     current_request_id,
+    default_objectives,
     default_tracer,
     get_logger,
 )
@@ -199,14 +203,24 @@ class _CompiledModel:
         )
         for b in config.precompile_batch_buckets:
             self.margin_for_bucket(self.bucket_of(b))
-        # Warm the micro-batcher's coalesced bucket too — margin AND SHAP,
-        # since a coalesced /predict batch dispatches both — so the first
-        # concurrent burst after startup or a hot swap never pays a compile
-        # stall mid-batch. /readyz reports both warmed sets.
+        # Warm the micro-batcher's coalescable buckets too — margin AND
+        # SHAP, since a coalesced /predict batch dispatches both — so the
+        # first concurrent burst after startup or a hot swap never pays a
+        # compile stall mid-batch. With ``prewarm_all_buckets`` (the
+        # default) EVERY power-of-two bucket the batcher can emit is
+        # warmed, not just the cap: a partially-filled coalescing window
+        # emits intermediate buckets, and a cold one is exactly the stray
+        # multi-hundred-ms compile BENCH_SERVE_r01 caught in its max.
+        # /readyz reports both warmed sets.
         if config.microbatch_enabled:
             cap = self.bucket_of(max(1, config.microbatch_max_rows))
-            self.margin_for_bucket(cap)
-            self.shap_for_bucket(cap)
+            if config.prewarm_all_buckets:
+                buckets = [1 << i for i in range(cap.bit_length())]
+            else:
+                buckets = [cap]
+            for b in buckets:
+                self.margin_for_bucket(b)
+                self.shap_for_bucket(b)
         total_gain, _ = gain_importances(forest, self.n_features)
         self.gain = np.asarray(total_gain)
 
@@ -446,8 +460,11 @@ class MicroBatcher:
         self, row: Mapping[str, float], deadline: Deadline | None
     ) -> Future:
         """Enqueue one validated request row; the returned future resolves to
-        ``(prob, shap_row | None, base_value | None, shap_error | None)`` or
-        raises the request's typed error."""
+        ``(prob, shap_row | None, base_value | None, shap_error | None,
+        phases)`` — ``phases`` being this request's
+        ``{queue_wait, dispatch, shap}`` seconds, measured on the worker and
+        handed back across the thread hop so `predict_single` can attribute
+        them on the request thread — or raises the request's typed error."""
         fut: Future = Future()
         entry = (row, deadline, fut, time.monotonic(), current_request_id())
         with self._cond:
@@ -575,27 +592,42 @@ class MicroBatcher:
             buf = scratch[:bucket]
             buf[:n] = model.rows_array([row for row, _, _, _, _ in live])
             buf[n:] = 0.0
-            xb = jnp.asarray(buf)
-            probs = np.asarray(
-                jax.nn.sigmoid(model.margin_for_bucket(bucket)(xb))
-            )[:n]
+            # Child spans time the two device phases separately — their
+            # durations ride each request's future back to the submitting
+            # thread, where they land in the phase histogram and flight
+            # record (the worker thread has no request context of its own).
+            # A cold-bucket compile happens inside the phase that pays it.
+            with default_tracer().span(
+                "serve.dispatch", rows=n, bucket=bucket
+            ) as d_sp:
+                xb = jnp.asarray(buf)
+                probs = np.asarray(
+                    jax.nn.sigmoid(model.margin_for_bucket(bucket)(xb))
+                )[:n]
             phis = base = None
             shap_error: str | None = None
-            shap_fn = model.shap_for_bucket(bucket)
-            if shap_fn is None:
-                shap_error = model.shap_error or "SHAP program unavailable"
-            else:
-                try:
-                    phis_all, base_v = shap_fn(xb)
-                    phis = np.asarray(phis_all)[:n]
-                    base = float(base_v)
-                except Exception as exc:
-                    shap_error = f"{type(exc).__name__}: {exc}"
+            with default_tracer().span(
+                "serve.shap", rows=n, bucket=bucket
+            ) as s_sp:
+                shap_fn = model.shap_for_bucket(bucket)
+                if shap_fn is None:
+                    shap_error = (
+                        model.shap_error or "SHAP program unavailable"
+                    )
+                else:
+                    try:
+                        phis_all, base_v = shap_fn(xb)
+                        phis = np.asarray(phis_all)[:n]
+                        base = float(base_v)
+                    except Exception as exc:
+                        shap_error = f"{type(exc).__name__}: {exc}"
+        dispatch_s = d_sp.duration_s or 0.0
+        shap_s = s_sp.duration_s or 0.0
         self._m_batches.inc()
         self._m_rows.inc(n)
         self._m_batch_rows.observe(n)
         self._m_max_batch.set_max(n)
-        for i, (_, dl, fut, _, _) in enumerate(live):
+        for i, (_, dl, fut, enq_t, _) in enumerate(live):
             if dl is not None and dl.expired():
                 # The dispatch itself cannot be interrupted; past the
                 # deadline the client is gone — 504, not a late 200 (the
@@ -609,6 +641,11 @@ class MicroBatcher:
                     None if phis is None else phis[i].tolist(),
                     base,
                     shap_error,
+                    {
+                        "queue_wait": max(0.0, now - enq_t),
+                        "dispatch": dispatch_s,
+                        "shap": shap_s,
+                    },
                 )
             )
 
@@ -645,6 +682,24 @@ class ScorerService:
         self.store_breaker = breaker or breaker_from_config(rel, clock=clock)
         self.admission = admission_from_config(rel, clock=clock)
         self._init_metrics()
+        # Tail-latency forensics (README "Debugging tail latency"): the
+        # flight recorder and SLO engine live next to the registry — a
+        # service owns its request records the way it owns its counters.
+        self.flight = FlightRecorder(
+            capacity=self.config.flight_capacity,
+            slow_threshold_s=self.config.flight_slow_threshold_ms / 1000.0,
+            top_k=self.config.flight_top_k,
+        )
+        self.slo: SLOEngine | None = None
+        if self.config.slo_enabled:
+            self.slo = SLOEngine(
+                self.registry,
+                default_objectives(self.config),
+                clock=clock,
+                windows_s=self.config.slo_windows_s,
+                fast_burn_threshold=self.config.slo_fast_burn_threshold,
+            )
+            self.slo.register_gauges()
         # One reload at a time; request threads never take this lock — they
         # read `_model` once and run against that snapshot.
         self._swap_lock = threading.Lock()
@@ -673,6 +728,12 @@ class ScorerService:
             "cobalt_request_latency_seconds",
             "request wall time by route and final HTTP status",
             ("route", "status"),
+        )
+        self._m_phase = reg.histogram(
+            "cobalt_request_phase_seconds",
+            "request wall time attributed to each serving phase "
+            "(validate / queue_wait / dispatch / shap / serialize)",
+            ("phase",),
         )
         self._m_errors = reg.counter(
             "cobalt_request_errors_total",
@@ -731,15 +792,39 @@ class ScorerService:
         status: int,
         duration_s: float,
         code: str | None = None,
+        trace_id: int | str | None = None,
     ) -> None:
         """Record one finished HTTP request — both adapters call this from
         their middleware with the normalized route template (never a raw
-        path: label cardinality must stay bounded)."""
+        path: label cardinality must stay bounded). ``trace_id`` (the
+        request's root span id) becomes the latency bucket's OpenMetrics
+        exemplar, linking an aggregate /metrics bucket back to one concrete
+        flight record / ``GET /debug/trace`` track."""
         self._m_latency.labels(route=route, status=str(status)).observe(
-            max(0.0, duration_s)
+            max(0.0, duration_s),
+            exemplar=None if trace_id is None else str(trace_id),
         )
         if status >= 400:
             self._m_errors.labels(route=route, code=code or "error").inc()
+
+    def _observe_phase(self, name: str, duration_s: float) -> None:
+        """One phase's wall time into the phase histogram AND the flight
+        record of the request in scope (no-op accumulator outside one)."""
+        duration_s = max(0.0, duration_s)
+        self._m_phase.labels(phase=name).observe(duration_s)
+        add_phase(name, duration_s)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Time one serving phase: a ``serve.<name>`` span on the default
+        tracer plus attribution via `_observe_phase`. Records even when the
+        block raises — time spent failing is exactly the time a tail
+        investigation needs to see."""
+        try:
+            with default_tracer().span(f"serve.{name}") as sp:
+                yield sp
+        finally:
+            self._observe_phase(name, sp.duration_s or 0.0)
 
     def close(self) -> None:
         """Stop the micro-batch worker (drains queued requests first);
@@ -964,6 +1049,7 @@ class ScorerService:
                     "enabled": True,
                     "max_wait_ms": self.config.microbatch_max_wait_ms,
                     "max_rows": self.config.microbatch_max_rows,
+                    "prewarm_all_buckets": self.config.prewarm_all_buckets,
                     **self.batcher.stats(),
                 }
             ),
@@ -984,9 +1070,10 @@ class ScorerService:
         request is coalesced with concurrent callers into one padded bucket
         dispatch; otherwise it scores on its own `(1, F)` programs."""
         dl = deadline if deadline is not None else self._new_deadline()
-        row = validate_single_input(payload)
-        if dl is not None:
-            dl.check("input validated")
+        with self.phase("validate"):
+            row = validate_single_input(payload)
+            if dl is not None:
+                dl.check("input validated")
         batcher = self.batcher
         fut = None
         if batcher is not None and not batcher.closed:
@@ -996,7 +1083,12 @@ class ScorerService:
                 fut = None  # closed in the gap: score on the direct path
         if fut is not None:
             # raises the request's typed error (e.g. DeadlineExceeded -> 504)
-            prob, phis_row, base, shap_error = fut.result()
+            prob, phis_row, base, shap_error, phases = fut.result()
+            # Phase attribution measured on the worker, recorded here on the
+            # request thread — where this request's flight accumulator and
+            # the phase histogram are in scope.
+            for phase_name, phase_s in phases.items():
+                self._observe_phase(phase_name, phase_s)
             model = self._model
             resp = {
                 "prob_default": prob,
@@ -1019,10 +1111,12 @@ class ScorerService:
                 self._m_shap_degraded.inc()
             return resp
         model = self._model
-        x = model.row_array(row)
-        margin = model.margin_fn(jnp.asarray(x))
+        with self.phase("dispatch"):
+            x = model.row_array(row)
+            margin = model.margin_fn(jnp.asarray(x))
+            prob = float(jax.nn.sigmoid(margin)[0])
         resp = {
-            "prob_default": float(jax.nn.sigmoid(margin)[0]),
+            "prob_default": prob,
             "features": list(model.feature_names),
             # Echo of the validated request (the reference echoes its input
             # df row). Keyed by the schema's canonical names, which equal the
@@ -1040,7 +1134,8 @@ class ScorerService:
                 dl.check("probability scored")
             if model.shap_fn is None:
                 raise RuntimeError(model.shap_error or "SHAP program unavailable")
-            phis, base = model.shap_fn(jnp.asarray(x))
+            with self.phase("shap"):
+                phis, base = model.shap_fn(jnp.asarray(x))
             resp["shap_values"] = np.asarray(phis)[0].tolist()
             resp["base_value"] = float(base)
         except DeadlineExceeded:

@@ -13,10 +13,27 @@ lacks. Three legs, one package:
 - `telemetry.tracing` — `span()` context manager with parent/child nesting,
   an injectable clock, a bounded ring buffer with JSON export, and
   pass-through to ``jax.profiler.TraceAnnotation`` during profiler captures.
+
+Tail-latency forensics (README "Debugging tail latency") ride on the same
+three legs:
+
+- `telemetry.flight` — bounded per-request flight recorder with phase
+  breakdowns and always-capture rules for slow/error requests
+  (``GET /debug/requests``, ``GET /debug/slowest``).
+- `telemetry.traceexport` — the span ring as Chrome Trace Event / Perfetto
+  JSON (``GET /debug/trace``).
+- `telemetry.slo` — declarative objectives evaluated as multi-window
+  error-budget burn rates (``GET /slo``, ``cobalt_slo_*`` gauges).
 """
 
 from __future__ import annotations
 
+from cobalt_smart_lender_ai_tpu.telemetry.flight import (
+    META_ROUTES,
+    FlightRecorder,
+    add_phase,
+    collect_phases,
+)
 from cobalt_smart_lender_ai_tpu.telemetry.logging import (
     StructuredLogger,
     current_request_id,
@@ -27,6 +44,7 @@ from cobalt_smart_lender_ai_tpu.telemetry.logging import (
 from cobalt_smart_lender_ai_tpu.telemetry.metrics import (
     EXPOSITION_CONTENT_TYPE,
     LATENCY_BUCKETS_S,
+    OPENMETRICS_CONTENT_TYPE,
     Counter,
     Gauge,
     Histogram,
@@ -36,9 +54,20 @@ from cobalt_smart_lender_ai_tpu.telemetry.metrics import (
     parse_exposition,
     render,
 )
+from cobalt_smart_lender_ai_tpu.telemetry.slo import (
+    Objective,
+    SLOEngine,
+    default_objectives,
+)
+from cobalt_smart_lender_ai_tpu.telemetry.traceexport import (
+    TRACE_CONTENT_TYPE,
+    chrome_trace,
+    render_chrome_trace,
+)
 from cobalt_smart_lender_ai_tpu.telemetry.tracing import (
     Span,
     Tracer,
+    current_trace_ids,
     default_tracer,
     record_span,
     span,
@@ -47,14 +76,25 @@ from cobalt_smart_lender_ai_tpu.telemetry.tracing import (
 __all__ = [
     "EXPOSITION_CONTENT_TYPE",
     "LATENCY_BUCKETS_S",
+    "META_ROUTES",
+    "OPENMETRICS_CONTENT_TYPE",
+    "TRACE_CONTENT_TYPE",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "Objective",
+    "SLOEngine",
     "Span",
     "StructuredLogger",
     "Tracer",
+    "add_phase",
+    "chrome_trace",
+    "collect_phases",
     "current_request_id",
+    "current_trace_ids",
+    "default_objectives",
     "default_registry",
     "default_tracer",
     "get_logger",
@@ -63,6 +103,7 @@ __all__ = [
     "parse_exposition",
     "record_span",
     "render",
+    "render_chrome_trace",
     "request_context",
     "span",
     "snapshot",

@@ -26,7 +26,12 @@ captured ids (tests/test_telemetry.py pins that propagation).
 Log schema (README "Observability")::
 
     {"ts": <unix seconds>, "level": "INFO", "logger": "cobalt.serve",
-     "event": "request_error", "request_id": "...", ...fields}
+     "event": "request_error", "request_id": "...",
+     "trace_id": <int>, "span_id": <int>, ...fields}
+
+``trace_id``/``span_id`` appear whenever a span is in scope on the default
+tracer — the same ids the flight recorder and ``GET /debug/trace`` carry,
+so one grep joins a log line to its flight record and Perfetto track.
 """
 
 from __future__ import annotations
@@ -110,6 +115,18 @@ class StructuredLogger:
         rid = current_request_id()
         if rid is not None:
             record["request_id"] = rid
+        # Stamp the active trace/span id next to the request id so logs,
+        # flight records and GET /debug/trace all join on one key. Lazy
+        # import: logging must not cost a tracing import at module load for
+        # consumers that never trace (and tracing imports nothing back).
+        if "trace_id" not in fields:
+            from cobalt_smart_lender_ai_tpu.telemetry.tracing import (
+                current_trace_ids,
+            )
+
+            ids = current_trace_ids()
+            if ids is not None:
+                record["trace_id"], record["span_id"] = ids
         record.update(fields)
         self._logger.log(
             level, json.dumps(record, default=_json_default, sort_keys=False)

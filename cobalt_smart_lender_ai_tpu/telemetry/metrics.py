@@ -33,6 +33,7 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 from typing import Callable, Iterable, Sequence
 
 __all__ = [
@@ -45,6 +46,8 @@ __all__ = [
     "parse_exposition",
     "render",
     "LATENCY_BUCKETS_S",
+    "EXPOSITION_CONTENT_TYPE",
+    "OPENMETRICS_CONTENT_TYPE",
 ]
 
 
@@ -200,8 +203,13 @@ class HistogramChild:
         self._bounds = buckets
         self._counts = [0] * (len(buckets) + 1)  # +1: the +Inf bucket
         self._sum = 0.0
+        # bucket index -> (exemplar trace id, observed value, unix ts): the
+        # most recent exemplar-carrying observation per bucket, the
+        # OpenMetrics link from an aggregate bucket back to one concrete
+        # request (GET /debug/trace resolves the id).
+        self._exemplars: dict[int, tuple[str, float, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str | None = None) -> None:
         value = float(value)
         with self._lock:
             self._sum += value
@@ -210,8 +218,22 @@ class HistogramChild:
             for i, bound in enumerate(self._bounds):
                 if value <= bound:
                     self._counts[i] += 1
-                    return
-            self._counts[-1] += 1
+                    break
+            else:
+                i = len(self._bounds)
+                self._counts[-1] += 1
+            if exemplar is not None:
+                self._exemplars[i] = (str(exemplar), value, time.time())
+
+    def exemplars(self) -> list[tuple[float, str, float, float]]:
+        """[(le, trace_id, observed_value, unix_ts)] — one per bucket that
+        has seen an exemplar-carrying observation."""
+        with self._lock:
+            bounds = self._bounds + (math.inf,)
+            return [
+                (bounds[i], tid, v, ts)
+                for i, (tid, v, ts) in sorted(self._exemplars.items())
+            ]
 
     @property
     def count(self) -> int:
@@ -358,8 +380,8 @@ class Histogram(_Family):
     def _make_child(self):
         return HistogramChild(self._lock, self.buckets)
 
-    def observe(self, value: float) -> None:
-        self._solo().observe(value)
+    def observe(self, value: float, exemplar: str | None = None) -> None:
+        self._solo().observe(value, exemplar=exemplar)
 
     @property
     def count(self) -> int:
@@ -426,20 +448,40 @@ class MetricsRegistry:
         with self._lock:
             return [self._families[k] for k in sorted(self._families)]
 
-    def render(self) -> str:
-        """Prometheus text exposition format 0.0.4 for every family."""
+    def render(self, *, openmetrics: bool = False) -> str:
+        """Prometheus text exposition format 0.0.4 for every family.
+
+        With ``openmetrics=True`` the output is the OpenMetrics-flavored
+        variant: histogram bucket lines carry their most recent exemplar
+        (``# {trace_id="..."} value ts``) and the body ends with ``# EOF``.
+        The adapters serve it on content negotiation
+        (``Accept: application/openmetrics-text``); the classic format —
+        what the strict `parse_exposition` and the CI scrape pin — stays
+        byte-identical to before exemplars existed."""
         lines: list[str] = []
         for fam in self.families():
             lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
             lines.append(f"# TYPE {fam.name} {fam.kind}")
             for labelvalues, child in fam._items():
                 if isinstance(child, HistogramChild):
+                    ex: dict[float, tuple[str, float, float]] = {}
+                    if openmetrics:
+                        ex = {
+                            le: (tid, v, ts)
+                            for le, tid, v, ts in child.exemplars()
+                        }
                     for le, cum in child.cumulative():
                         lv = labelvalues + (_format_value(le),)
                         ln = fam.labelnames + ("le",)
-                        lines.append(
-                            f"{fam.name}_bucket{_label_str(ln, lv)} {cum}"
-                        )
+                        line = f"{fam.name}_bucket{_label_str(ln, lv)} {cum}"
+                        e = ex.get(le)
+                        if e is not None:
+                            tid, v, ts = e
+                            line += (
+                                f' # {{trace_id="{_escape_label_value(tid)}"}}'
+                                f" {_format_value(v)} {ts:.3f}"
+                            )
+                        lines.append(line)
                     ls = _label_str(fam.labelnames, labelvalues)
                     lines.append(
                         f"{fam.name}_sum{ls} {_format_value(child.sum)}"
@@ -450,7 +492,10 @@ class MetricsRegistry:
                     lines.append(
                         f"{fam.name}{ls} {_format_value(child.value)}"
                     )
-        return "\n".join(lines) + "\n" if lines else ""
+        body = "\n".join(lines) + "\n" if lines else ""
+        if openmetrics:
+            body += "# EOF\n"
+        return body
 
     def snapshot(self) -> dict:
         """JSON-able dump (bench records ride this next to their one line)."""
@@ -498,12 +543,20 @@ def default_registry() -> MetricsRegistry:
     return _default_registry
 
 
-def render(registry: MetricsRegistry | None = None) -> str:
-    return (registry or _default_registry).render()
+def render(
+    registry: MetricsRegistry | None = None, *, openmetrics: bool = False
+) -> str:
+    return (registry or _default_registry).render(openmetrics=openmetrics)
 
 
 #: Content-Type for the exposition (adapters send it on ``GET /metrics``).
 EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: Content-Type for the exemplar-carrying OpenMetrics variant, served when
+#: the scraper sends ``Accept: application/openmetrics-text``.
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 
 def parse_exposition(text: str) -> dict[str, dict]:
@@ -518,7 +571,9 @@ def parse_exposition(text: str) -> dict[str, dict]:
     sample_re = re.compile(
         r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
         r"(?:\{(?P<labels>.*)\})?"
-        r" (?P<value>[^ ]+)$"
+        r" (?P<value>[^ ]+)"
+        # optional OpenMetrics exemplar: `# {trace_id="..."} value [ts]`
+        r"(?: # \{(?P<exemplar>[^}]*)\} [^ ]+(?: [^ ]+)?)?$"
     )
     label_re = re.compile(
         r'(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"'
@@ -584,4 +639,10 @@ def parse_exposition(text: str) -> dict[str, dict]:
             f'|{k}={labels[k]}' for k in sorted(labels)
         )
         fam["samples"][key] = value
+        raw_ex = m.group("exemplar")
+        if raw_ex:
+            fam.setdefault("exemplars", {})[key] = {
+                lm.group("name"): lm.group("value")
+                for lm in label_re.finditer(raw_ex)
+            }
     return families

@@ -34,14 +34,29 @@ import threading
 import time
 from typing import Any, Callable, Iterator
 
-__all__ = ["Span", "Tracer", "default_tracer", "span", "record_span"]
+__all__ = [
+    "Span",
+    "Tracer",
+    "current_trace_ids",
+    "default_tracer",
+    "record_span",
+    "span",
+]
 
 
 class Span:
-    """One finished (or in-flight) timed region."""
+    """One finished (or in-flight) timed region.
+
+    ``trace_id`` is the span_id of the root span of the request/run this
+    span belongs to (Dapper's trace id): a root span is its own trace, a
+    child inherits its parent's. Every telemetry surface joins on it — log
+    lines carry it, flight records index by it, and the Chrome-trace export
+    puts it in each event's args. ``thread_id`` is captured at creation so
+    the export can lay spans out per-thread (Perfetto tracks)."""
 
     __slots__ = (
-        "name", "span_id", "parent_id", "start_s", "end_s", "attrs",
+        "name", "span_id", "parent_id", "trace_id", "start_s", "end_s",
+        "attrs", "thread_id", "thread_name",
     )
 
     def __init__(
@@ -51,13 +66,18 @@ class Span:
         parent_id: int | None,
         start_s: float,
         attrs: dict[str, Any],
+        trace_id: int | None = None,
     ):
         self.name = name
         self.span_id = span_id
         self.parent_id = parent_id
+        self.trace_id = trace_id if trace_id is not None else span_id
         self.start_s = start_s
         self.end_s: float | None = None
         self.attrs = attrs
+        t = threading.current_thread()
+        self.thread_id = t.ident or 0
+        self.thread_name = t.name
 
     @property
     def duration_s(self) -> float | None:
@@ -70,6 +90,9 @@ class Span:
             "name": self.name,
             "span_id": self.span_id,
             "parent_id": self.parent_id,
+            "trace_id": self.trace_id,
+            "thread_id": self.thread_id,
+            "thread_name": self.thread_name,
             "start_s": round(self.start_s, 6),
             "duration_s": (
                 None
@@ -139,6 +162,7 @@ class Tracer:
             None if parent is None else parent.span_id,
             self._clock(),
             attrs,
+            trace_id=None if parent is None else parent.trace_id,
         )
         token = self._current.set(sp)
         try:
@@ -166,6 +190,7 @@ class Tracer:
             None if parent is None else parent.span_id,
             start_s,
             attrs,
+            trace_id=None if parent is None else parent.trace_id,
         )
         sp.end_s = end_s
         with self._lock:
@@ -199,3 +224,13 @@ def span(name: str, **attrs: Any):
 
 def record_span(name: str, start_s: float, end_s: float, **attrs: Any) -> Span:
     return _default_tracer.record_span(name, start_s, end_s, **attrs)
+
+
+def current_trace_ids() -> tuple[int, int] | None:
+    """(trace_id, span_id) of the span in scope on the default tracer, or
+    None outside any span — the join key `StructuredLogger` stamps on every
+    log line so logs, flight records and the trace export correlate."""
+    sp = _default_tracer.current()
+    if sp is None:
+        return None
+    return (sp.trace_id, sp.span_id)

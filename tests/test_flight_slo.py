@@ -1,0 +1,516 @@
+"""Tail-latency forensics: flight-recorder capture rules, SLO burn-rate
+math under a fake clock, Chrome-trace export validity, exemplar round-trip,
+trace ids on log lines — and the end-to-end acceptance drill: an injected
+slow SHAP call must be nameable from the outside (README "Debugging tail
+latency")."""
+
+import json
+import logging
+import threading
+import urllib.request
+
+import pytest
+
+from cobalt_smart_lender_ai_tpu.telemetry import (
+    FlightRecorder,
+    MetricsRegistry,
+    Objective,
+    SLOEngine,
+    Tracer,
+    add_phase,
+    chrome_trace,
+    collect_phases,
+    get_logger,
+    parse_exposition,
+    render_chrome_trace,
+)
+from cobalt_smart_lender_ai_tpu.telemetry.flight import PhaseAccumulator
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# --- flight recorder: rings, capture rules, top-K board -----------------------
+
+
+def _rec(fr, *, duration_s, status=200, rid="r", phases=None):
+    return fr.record(
+        request_id=rid,
+        trace_id=7,
+        route="/predict",
+        method="POST",
+        status=status,
+        duration_s=duration_s,
+        phases=phases,
+    )
+
+
+def test_recent_ring_bounded_newest_first():
+    fr = FlightRecorder(capacity=4, slow_threshold_s=1.0, clock=FakeClock())
+    for i in range(10):
+        _rec(fr, duration_s=0.001, rid=f"r{i}")
+    recs = fr.records()
+    assert [r["request_id"] for r in recs] == ["r9", "r8", "r7", "r6"]
+    assert fr.stats()["recorded"] == 10
+
+
+def test_error_ring_survives_a_burst_of_healthy_traffic():
+    """The one 500 an operator is hunting must not be evicted by fast 200s
+    — the always-capture rule the recent ring alone can't give."""
+    fr = FlightRecorder(capacity=8, slow_threshold_s=1.0, clock=FakeClock())
+    _rec(fr, duration_s=0.002, status=500, rid="the-bad-one")
+    for i in range(50):
+        _rec(fr, duration_s=0.001, rid=f"ok{i}")
+    assert all(r["request_id"] != "the-bad-one" for r in fr.records())
+    errs = fr.errors()
+    assert [r["request_id"] for r in errs] == ["the-bad-one"]
+    assert errs[0]["error"] and errs[0]["status"] == 500
+    assert fr.stats()["errors"] == 1
+
+
+def test_slowest_board_keeps_topk_ever_seen_not_ring_window():
+    fr = FlightRecorder(capacity=4, slow_threshold_s=0.1, top_k=3,
+                        clock=FakeClock())
+    _rec(fr, duration_s=9.0, rid="slowest-ever")
+    for i in range(20):  # plenty to evict it from the recent ring
+        _rec(fr, duration_s=0.001 + i * 1e-6, rid=f"fast{i}")
+    _rec(fr, duration_s=3.0, rid="second")
+    _rec(fr, duration_s=5.0, rid="third")
+    board = fr.slowest()
+    assert [r["request_id"] for r in board] == [
+        "slowest-ever", "third", "second",
+    ]
+    assert [r["slow"] for r in board] == [True, True, True]
+    assert fr.slowest(1)[0]["request_id"] == "slowest-ever"
+    assert fr.stats()["slow"] == 3
+
+
+def test_record_phases_rounding_and_unattributed_remainder():
+    fr = FlightRecorder(capacity=4, slow_threshold_s=0.05, clock=FakeClock())
+    rec = _rec(
+        fr,
+        duration_s=0.1,
+        phases={"dispatch": 0.06, "shap": 0.0301, "validate": 0.0},
+    )
+    # zero-duration phases are dropped; the rest round to ms
+    assert rec["phases_ms"] == {"dispatch": 60.0, "shap": 30.1}
+    assert rec["other_ms"] == pytest.approx(9.9, abs=0.01)
+    assert rec["slow"] and not rec["error"]
+    over = _rec(fr, duration_s=0.01, phases={"dispatch": 0.02})
+    assert over["other_ms"] == 0.0  # clamped: attribution can over-count
+
+
+def test_phase_accumulator_contextvar_scoping():
+    acc = PhaseAccumulator()
+    acc.add("shap", 0.01)
+    acc.add("shap", 0.02)
+    acc.add("dispatch", -5.0)  # negative clamps to zero, never subtracts
+    assert acc.phases == {"shap": pytest.approx(0.03), "dispatch": 0.0}
+
+    add_phase("dispatch", 1.0)  # outside any block: silently dropped
+    with collect_phases() as phases:
+        add_phase("dispatch", 0.5)
+    assert phases.phases == {"dispatch": 0.5}
+    add_phase("dispatch", 1.0)  # after the block: dropped again
+    assert phases.phases == {"dispatch": 0.5}
+
+
+# --- SLO engine: burn-rate math under a fake clock ----------------------------
+
+BUCKETS = (0.005, 0.01, 0.05, 0.1, 1.0)
+
+
+def _latency_engine(clk, *, target=0.99, threshold_s=0.01,
+                    windows=(60.0, 3600.0)):
+    reg = MetricsRegistry()
+    hist = reg.histogram(
+        "cobalt_request_latency_seconds", "t", ("route", "status"),
+        buckets=BUCKETS,
+    )
+    obj = Objective(
+        name="latency", kind="latency", target=target,
+        labels={"route": "/predict"}, threshold_s=threshold_s,
+    )
+    return reg, hist, SLOEngine(reg, [obj], clock=clk, windows_s=windows)
+
+
+def test_burn_rate_is_bad_fraction_over_budget():
+    clk = FakeClock()
+    _, hist, eng = _latency_engine(clk)  # budget = 1 - 0.99 = 1%
+    child = hist.labels(route="/predict", status="200")
+    for _ in range(98):
+        child.observe(0.004)  # good: under the 10ms effective threshold
+    for _ in range(2):
+        child.observe(0.5)  # bad
+    clk.advance(30.0)
+    report = eng.evaluate(force=True)
+    (obj,) = report["objectives"]
+    assert obj["total"] == 100 and obj["bad"] == 2
+    for win in obj["windows"]:
+        # 2% bad against a 1% budget: burning twice the allowed pace,
+        # measured against the zero-counts snapshot seeded at engine birth
+        assert win["total"] == 100 and win["bad"] == 2
+        assert win["bad_ratio"] == pytest.approx(0.02)
+        assert win["burn_rate"] == pytest.approx(2.0)
+    assert not obj["fast_burn"] and not report["fast_burn"]
+    assert obj["threshold_ms"] == 10.0
+    assert obj["effective_threshold_ms"] == 10.0
+
+
+def test_fast_burn_needs_every_window_over_threshold():
+    """A 100%-bad burst after an hour of clean traffic floods the 1-minute
+    window but not the 1-hour one — fast_burn stays down until the burn is
+    sustained (the SRE-workbook multi-window AND)."""
+    clk = FakeClock()
+    _, hist, eng = _latency_engine(clk)
+    good = hist.labels(route="/predict", status="200")
+    for _ in range(1000):
+        good.observe(0.004)
+    clk.advance(3500.0)
+    eng.evaluate(force=True)  # snapshot: (1000 good, 1000 total) @ t=3500
+    clk.advance(60.0)
+    for _ in range(20):
+        good.observe(0.5)  # burst: every request bad
+    report = eng.evaluate(force=True)
+    (obj,) = report["objectives"]
+    short, long_ = obj["windows"]
+    assert short["window_s"] == 60.0
+    assert short["total"] == 20 and short["bad"] == 20
+    assert short["burn_rate"] == pytest.approx(100.0)
+    assert long_["total"] == 1020 and long_["bad"] == 20
+    assert long_["burn_rate"] < 14.4
+    assert not obj["fast_burn"]
+
+
+def test_fast_burn_when_all_windows_burn():
+    clk = FakeClock()
+    _, hist, eng = _latency_engine(clk)
+    child = hist.labels(route="/predict", status="200")
+    for _ in range(50):
+        child.observe(0.5)  # nothing but bad requests since birth
+    clk.advance(10.0)
+    report = eng.evaluate(force=True)
+    (obj,) = report["objectives"]
+    assert all(w["burn_rate"] == pytest.approx(100.0) for w in obj["windows"])
+    assert obj["fast_burn"] and report["fast_burn"]
+
+
+def test_windowed_delta_not_cumulative():
+    """Old badness must age out of the short window: burn is computed from
+    snapshot deltas, not lifetime totals."""
+    clk = FakeClock()
+    _, hist, eng = _latency_engine(clk)
+    child = hist.labels(route="/predict", status="200")
+    for _ in range(10):
+        child.observe(0.5)  # a bad start
+    clk.advance(5.0)
+    assert eng.evaluate(force=True)["objectives"][0]["fast_burn"]
+    for t in range(12):  # 2 minutes of clean traffic, snapshotted along
+        clk.advance(10.0)
+        for _ in range(50):
+            child.observe(0.004)
+        eng.evaluate(force=True)
+    report = eng.evaluate(force=True)
+    (obj,) = report["objectives"]
+    short = obj["windows"][0]
+    assert short["bad"] == 0 and short["burn_rate"] == 0.0
+    assert not obj["fast_burn"]
+    assert obj["bad"] == 10  # lifetime counters still tell the whole story
+
+
+def test_effective_threshold_snaps_to_bucket_resolution():
+    clk = FakeClock()
+    _, _, eng = _latency_engine(clk, threshold_s=0.03)
+    (obj,) = eng.objectives
+    # 30ms sits between the 10ms and 50ms buckets: the histogram can only
+    # answer at 10ms, and the report must say so
+    assert eng.effective_threshold_s(obj) == 0.01
+    report = eng.evaluate(force=True)
+    assert report["objectives"][0]["threshold_ms"] == 30.0
+    assert report["objectives"][0]["effective_threshold_ms"] == 10.0
+
+
+def test_availability_counts_5xx_bad_and_shed_429_good():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    hist = reg.histogram(
+        "cobalt_request_latency_seconds", "t", ("route", "status"),
+        buckets=BUCKETS,
+    )
+    obj = Objective(
+        name="availability", kind="availability", target=0.999,
+        labels={"route": ("/predict", "/predict_bulk_csv")},
+    )
+    eng = SLOEngine(reg, [obj], clock=clk)
+    for status, n in (("200", 90), ("429", 5), ("422", 3), ("500", 2)):
+        child = hist.labels(route="/predict", status=status)
+        for _ in range(n):
+            child.observe(0.004)
+    # a 500 on a non-scoring route must not count against the objective
+    hist.labels(route="/metrics", status="500").observe(0.001)
+    clk.advance(1.0)
+    report = eng.evaluate(force=True)
+    (out,) = report["objectives"]
+    assert out["total"] == 100
+    assert out["bad"] == 2  # the 5xx only; 429/422 are policy, not downtime
+    assert out["windows"][0]["bad_ratio"] == pytest.approx(0.02)
+
+
+def test_slo_gauges_mirror_the_report():
+    clk = FakeClock()
+    reg, hist, eng = _latency_engine(clk)
+    eng.register_gauges()
+    child = hist.labels(route="/predict", status="200")
+    for _ in range(10):
+        child.observe(0.5)
+    clk.advance(10.0)
+    eng.evaluate(force=True)
+    families = parse_exposition(reg.render())
+    samples = families["cobalt_slo_burn_rate"]["samples"]
+    assert samples["cobalt_slo_burn_rate|objective=latency|window=60s"] \
+        == pytest.approx(100.0)
+    assert families["cobalt_slo_fast_burn"]["samples"][
+        "cobalt_slo_fast_burn|objective=latency"
+    ] == 1.0
+    assert families["cobalt_slo_target"]["samples"][
+        "cobalt_slo_target|objective=latency"
+    ] == pytest.approx(0.99)
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError, match="kind"):
+        Objective(name="x", kind="speed", target=0.9)
+    with pytest.raises(ValueError, match="target"):
+        Objective(name="x", kind="availability", target=1.0)
+    with pytest.raises(ValueError, match="threshold_s"):
+        Objective(name="x", kind="latency", target=0.99)
+
+
+# --- Chrome-trace export ------------------------------------------------------
+
+
+def test_chrome_trace_events_nest_and_ids_match_the_ring():
+    clk = FakeClock(100.0)
+    tracer = Tracer(clock=clk, jax_annotations=False)
+    with tracer.span("http.request", route="/predict") as root:
+        clk.advance(0.001)
+        with tracer.span("serve.dispatch") as child:
+            clk.advance(0.005)
+        clk.advance(0.001)
+
+    doc = json.loads(render_chrome_trace(tracer))  # must be valid JSON
+    assert doc["displayTimeUnit"] == "ms"
+    complete = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert set(complete) == {"http.request", "serve.dispatch"}
+    parent, kid = complete["http.request"], complete["serve.dispatch"]
+    # ids join back to the span ring / flight records
+    assert parent["args"]["span_id"] == root.span_id
+    assert parent["args"]["parent_id"] is None
+    assert kid["args"]["parent_id"] == root.span_id
+    assert kid["args"]["trace_id"] == root.trace_id == root.span_id
+    assert parent["args"]["route"] == "/predict"
+    # microsecond complete events, child strictly inside the parent
+    assert kid["ts"] >= parent["ts"]
+    assert kid["ts"] + kid["dur"] <= parent["ts"] + parent["dur"]
+    assert parent["dur"] == pytest.approx(7000.0)  # 7ms in us
+    # one thread_name metadata event names the track
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert len(meta) == 1 and meta[0]["name"] == "thread_name"
+    assert meta[0]["tid"] == parent["tid"]
+
+
+def test_chrome_trace_skips_unfinished_spans():
+    clk = FakeClock()
+    tracer = Tracer(clock=clk, jax_annotations=False)
+    with tracer.span("done"):
+        clk.advance(0.001)
+    tracer.record_span("also_done", 5.0, 6.0)
+    # only finished spans reach the ring, so every event has an extent
+    doc = chrome_trace(tracer)
+    assert {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"} == {
+        "done", "also_done",
+    }
+    assert doc["otherData"]["span_count"] == 2
+
+
+# --- exemplars: /metrics buckets link back to traces --------------------------
+
+
+def test_latency_exemplar_roundtrip_openmetrics_only():
+    reg = MetricsRegistry()
+    hist = reg.histogram("h_seconds", "t", ("route",), buckets=(0.01, 1.0))
+    hist.labels(route="/p").observe(0.004, exemplar="12345")
+
+    classic = reg.render()
+    assert "trace_id" not in classic and "# EOF" not in classic
+    parse_exposition(classic)
+
+    om = reg.render(openmetrics=True)
+    assert om.rstrip().endswith("# EOF")
+    fams = parse_exposition(om)
+    exemplars = fams["h_seconds"]["exemplars"]
+    assert exemplars["h_seconds_bucket|le=0.01|route=/p"]["trace_id"] == "12345"
+    # exemplar rides the first bucket the observation lands in, only there
+    assert all("le=+Inf" not in k for k in exemplars)
+
+
+# --- log lines carry trace ids ------------------------------------------------
+
+
+def test_log_lines_inside_a_span_carry_trace_and_span_ids(caplog):
+    from cobalt_smart_lender_ai_tpu.telemetry import default_tracer
+
+    log = get_logger("test.flight")
+    with caplog.at_level(logging.INFO, logger="cobalt.test.flight"):
+        with default_tracer().span("http.request") as root:
+            with default_tracer().span("serve.shap") as child:
+                log.info("explaining")
+        log.info("after")
+    inside = json.loads(caplog.records[0].getMessage())
+    assert inside["trace_id"] == root.span_id == child.trace_id
+    assert inside["span_id"] == child.span_id
+    outside = json.loads(caplog.records[1].getMessage())
+    assert "trace_id" not in outside and "span_id" not in outside
+
+
+# --- prewarm: every coalescable bucket compiled at startup --------------------
+
+
+def test_prewarm_compiles_every_power_of_two_bucket(serving_artifact):
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(
+        store,
+        ServeConfig(precompile_batch_buckets=(), microbatch_max_rows=4),
+    )
+    try:
+        ready, payload = svc.ready()
+        assert ready
+        assert payload["microbatch"]["prewarm_all_buckets"] is True
+        # /readyz lists the warmed set: margin AND shap for 1, 2, 4
+        assert set(payload["compiled_batch_buckets"]) >= {1, 2, 4}
+        assert set(payload["compiled_shap_buckets"]) >= {1, 2, 4}
+    finally:
+        svc.close()
+
+    svc = ScorerService.from_store(
+        store,
+        ServeConfig(
+            precompile_batch_buckets=(),
+            microbatch_max_rows=4,
+            prewarm_all_buckets=False,
+        ),
+    )
+    try:
+        _, payload = svc.ready()
+        assert payload["microbatch"]["prewarm_all_buckets"] is False
+        assert 2 not in payload["compiled_batch_buckets"]  # only the cap
+        assert 4 in payload["compiled_batch_buckets"]
+    finally:
+        svc.close()
+
+
+# --- acceptance: the injected slow request is nameable from the outside -------
+
+
+def _payload() -> dict:
+    from cobalt_smart_lender_ai_tpu.data import schema
+    from cobalt_smart_lender_ai_tpu.serve.service import SINGLE_INPUT_FIELDS
+
+    return {
+        canonical: 1 if canonical in schema.SERVING_INT_FEATURES else 1.5
+        for canonical in SINGLE_INPUT_FIELDS.values()
+    }
+
+
+def test_slow_request_visible_end_to_end(serving_artifact):
+    """The ISSUE acceptance drill over a real socket: inject one slow SHAP
+    call, then (a) /debug/slowest names the request and blames the shap
+    phase, (b) its trace id resolves in /debug/trace to a serve.shap span,
+    (c) /slo shows the latency objectives burning while availability stays
+    clean."""
+    import time
+
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(
+        store,
+        ServeConfig(
+            precompile_batch_buckets=(),
+            microbatch_enabled=False,  # direct path: no prewarm, no worker
+            flight_slow_threshold_ms=50.0,
+            slo_p99_ms=10.0,
+        ),
+    )
+    orig_shap = svc._model.shap_fn
+
+    def slow_shap(*args, **kwargs):
+        time.sleep(0.12)
+        return orig_shap(*args, **kwargs)
+
+    svc._model.shap_fn = slow_shap
+    httpd = make_server(svc, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    try:
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps(_payload()).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "X-Request-ID": "slow-one",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+
+        # (a) the flight recorder names the request and the phase
+        board = get("/debug/slowest?k=5")["slowest"]
+        rec = board[0]
+        assert rec["request_id"] == "slow-one" and rec["slow"]
+        assert max(rec["phases_ms"], key=rec["phases_ms"].get) == "shap"
+        assert rec["phases_ms"]["shap"] >= 100.0
+        recent = get("/debug/requests?n=5")["recent"]
+        assert recent[0]["request_id"] == "slow-one"
+
+        # (b) its trace id resolves on the exported timeline
+        events = get("/debug/trace")["traceEvents"]
+        mine = [
+            e for e in events
+            if e["ph"] == "X" and e["args"].get("trace_id") == rec["trace_id"]
+        ]
+        names = {e["name"] for e in mine}
+        assert {"http.request", "serve.shap"} <= names
+        shap_ev = next(e for e in mine if e["name"] == "serve.shap")
+        assert shap_ev["dur"] >= 100_000  # >=100ms, in microseconds
+
+        # (c) the SLO engine sees the burn — latency only
+        report = get("/slo")
+        by_name = {o["name"]: o for o in report["objectives"]}
+        p99 = by_name["predict_latency_p99"]
+        assert p99["bad"] >= 1 and p99["fast_burn"]
+        assert by_name["availability"]["bad"] == 0
+        assert not by_name["availability"]["fast_burn"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        svc.close()

@@ -51,6 +51,7 @@ def _payload(seed: float = 1.5) -> dict:
 def _cfg(max_wait_ms: float = 25.0, max_rows: int = 16, **rel) -> ServeConfig:
     return ServeConfig(
         precompile_batch_buckets=(),
+        prewarm_all_buckets=False,  # compile only the cap: keeps tier-1 fast
         microbatch_max_wait_ms=max_wait_ms,
         microbatch_max_rows=max_rows,
         reliability=ReliabilityConfig(**rel),

@@ -39,6 +39,15 @@ from cobalt_smart_lender_ai_tpu.reliability import (
 )
 
 
+def _fast_cfg():
+    """Default serving config minus the all-bucket prewarm — this module
+    doesn't exercise cold-bucket tails, and the extra per-bucket compiles
+    are pure tier-1 wall time."""
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+
+    return ServeConfig(prewarm_all_buckets=False)
+
+
 class FakeClock:
     """Deterministic sleep/monotonic pair: sleeping advances the clock."""
 
@@ -508,7 +517,7 @@ def degraded_service(serving_artifact, monkeypatch):
 
     monkeypatch.setattr(service_mod, "shap_values", broken_shap)
     store, _ = serving_artifact
-    return service_mod.ScorerService.from_store(store)
+    return service_mod.ScorerService.from_store(store, _fast_cfg())
 
 
 def _contract_payload() -> dict:
@@ -539,7 +548,7 @@ def test_degraded_flag_absent_when_healthy(serving_artifact):
     from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 
     store, _ = serving_artifact
-    svc = ScorerService.from_store(store)
+    svc = ScorerService.from_store(store, _fast_cfg())
     resp = svc.predict_single(_contract_payload())
     # the reference's exact response keys — no degraded flag on healthy paths
     assert set(resp) == {
@@ -553,7 +562,7 @@ def test_runtime_shap_failure_degrades(serving_artifact):
     from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 
     store, _ = serving_artifact
-    svc = ScorerService.from_store(store)
+    svc = ScorerService.from_store(store, _fast_cfg())
 
     def exec_boom(x):
         raise RuntimeError("device OOM mid-shap")

@@ -124,7 +124,9 @@ def _csv_bytes(X: np.ndarray, n: int) -> bytes:
 
 def _cfg(**rel) -> ServeConfig:
     return ServeConfig(
-        precompile_batch_buckets=(), reliability=ReliabilityConfig(**rel)
+        precompile_batch_buckets=(),
+        prewarm_all_buckets=False,  # compile only the cap: keeps tier-1 fast
+        reliability=ReliabilityConfig(**rel),
     )
 
 

@@ -21,10 +21,20 @@ from cobalt_smart_lender_ai_tpu.serve import (
 # serving_artifact lives in conftest.py (shared with the fastapi stub tests)
 
 
+def _fast_cfg():
+    """Default serving config minus the all-bucket prewarm — this module
+    doesn't exercise cold-bucket tails, and the extra per-bucket compiles
+    are pure tier-1 wall time."""
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+
+    return ServeConfig(prewarm_all_buckets=False)
+
+
+
 @pytest.fixture(scope="module")
 def service(serving_artifact):
     store, _ = serving_artifact
-    return ScorerService.from_store(store)
+    return ScorerService.from_store(store, _fast_cfg())
 
 
 def _example_payload(aliased: bool = True) -> dict:

@@ -27,6 +27,16 @@ import pytest
 from cobalt_smart_lender_ai_tpu.data import schema
 from cobalt_smart_lender_ai_tpu.serve.service import SINGLE_INPUT_FIELDS
 
+
+def _fast_cfg():
+    """Default serving config minus the all-bucket prewarm — this module
+    doesn't exercise cold-bucket tails, and the extra per-bucket compiles
+    are pure tier-1 wall time."""
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+
+    return ServeConfig(prewarm_all_buckets=False)
+
+
 ADAPTER = (
     Path(__file__).resolve().parent.parent
     / "cobalt_smart_lender_ai_tpu"
@@ -191,7 +201,7 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
     from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 
     store, X = serving_artifact
-    svc = ScorerService.from_store(store)
+    svc = ScorerService.from_store(store, _fast_cfg())
     app = create_app(service=svc)
     assert set(app.routes) == {
         "/predict",
@@ -199,7 +209,15 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
         "/feature_importance_bulk",
         "/admin/reload",
     }
-    assert set(app.get_routes) == {"/healthz", "/readyz", "/metrics"}
+    assert set(app.get_routes) == {
+        "/healthz",
+        "/readyz",
+        "/metrics",
+        "/slo",
+        "/debug/requests",
+        "/debug/slowest",
+        "/debug/trace",
+    }
 
     # health/readiness GET routes: healthy service -> ok, shap ok, 200 path
     assert app.get_routes["/healthz"]() == {"status": "ok"}

@@ -16,6 +16,16 @@ from cobalt_smart_lender_ai_tpu.data import schema
 from cobalt_smart_lender_ai_tpu.data.split import train_test_split_hashed
 from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
 from cobalt_smart_lender_ai_tpu.serve import ScorerService
+
+
+def _fast_cfg():
+    """Default serving config minus the all-bucket prewarm — this module
+    doesn't exercise cold-bucket tails, and the extra per-bucket compiles
+    are pure tier-1 wall time."""
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+
+    return ServeConfig(prewarm_all_buckets=False)
+
 from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
 
 
@@ -42,7 +52,7 @@ def smoke_env(tmp_path_factory, engineered):
         bin_spec=model.bin_spec,
         feature_names=tuple(schema.SERVING_FEATURES),
     ).save(store, "models/gbdt/model_tree")
-    service = ScorerService.from_store(store)
+    service = ScorerService.from_store(store, _fast_cfg())
     httpd = make_server(service, "127.0.0.1", 0)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     url = f"http://127.0.0.1:{httpd.server_address[1]}"

@@ -240,6 +240,7 @@ def _cfg(**kw):
     from cobalt_smart_lender_ai_tpu.config import ServeConfig
 
     kw.setdefault("precompile_batch_buckets", ())
+    kw.setdefault("prewarm_all_buckets", False)  # keep tier-1 compile count flat
     kw.setdefault("microbatch_max_wait_ms", 25.0)
     return ServeConfig(**kw)
 

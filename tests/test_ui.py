@@ -19,6 +19,16 @@ from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
 from cobalt_smart_lender_ai_tpu.serve import ScorerService
 from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
 from cobalt_smart_lender_ai_tpu.serve.service import validate_single_input
+
+
+def _fast_cfg():
+    """Default serving config minus the all-bucket prewarm — this module
+    doesn't exercise cold-bucket tails, and the extra per-bucket compiles
+    are pure tier-1 wall time."""
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+
+    return ServeConfig(prewarm_all_buckets=False)
+
 from cobalt_smart_lender_ai_tpu.ui import core
 
 
@@ -37,7 +47,9 @@ def ui_env(tmp_path_factory, engineered):
         bin_spec=model.bin_spec,
         feature_names=tuple(schema.SERVING_FEATURES),
     ).save(store, "models/gbdt/model_tree")
-    httpd = make_server(ScorerService.from_store(store), "127.0.0.1", 0)
+    httpd = make_server(
+        ScorerService.from_store(store, _fast_cfg()), "127.0.0.1", 0
+    )
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     yield core.ApiClient(f"http://127.0.0.1:{httpd.server_address[1]}")
     httpd.shutdown()

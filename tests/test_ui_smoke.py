@@ -21,6 +21,16 @@ import pytest
 from cobalt_smart_lender_ai_tpu.data import schema
 
 
+def _fast_cfg():
+    """Default serving config minus the all-bucket prewarm — this module
+    doesn't exercise cold-bucket tails, and the extra per-bucket compiles
+    are pure tier-1 wall time."""
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+
+    return ServeConfig(prewarm_all_buckets=False)
+
+
+
 class _Sidebar:
     def __init__(self, app):
         self.app = app
@@ -123,7 +133,9 @@ def live_server(serving_artifact):
     from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 
     store, X = serving_artifact
-    server = make_server(ScorerService.from_store(store), "127.0.0.1", 0)
+    server = make_server(
+        ScorerService.from_store(store, _fast_cfg()), "127.0.0.1", 0
+    )
     threading.Thread(target=server.serve_forever, daemon=True).start()
     yield f"http://127.0.0.1:{server.server_address[1]}", X
     server.shutdown()
