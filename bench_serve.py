@@ -18,6 +18,21 @@ burning minutes.
 Latency percentiles are computed over single-row requests only (bulk calls
 are reported separately) and the warmup window — which absorbs lazy bucket
 compiles — is excluded from every metric.
+
+``--bulk`` switches to the mesh-sharded bulk-scoring bench (README "Scaling
+out"): score one large (N, F) matrix through `ScorerService.predict_proba`
+at each requested ``bulk_shards`` setting and record rows/s per shard count
+plus the sharded-vs-single speedup and a bit-identity check, suitable for
+committing as a ``BENCH_BULK_*.json`` record:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 JAX_PLATFORMS=cpu \\
+        python bench_serve.py --bulk --out BENCH_BULK_r01.json
+
+(or pass ``--force-devices 4``, which sets the flag before JAX loads).
+The record carries ``host_cpu_cores``: on a single-core host the forced
+devices share one core, so the curve flattens — the scaling headroom shows
+on hosts with >= one core per forced device, which is what the CI
+bulk-smoke job runs.
 """
 
 from __future__ import annotations
@@ -338,6 +353,94 @@ def run_http_smoke(
     }
 
 
+def run_bulk_bench(
+    artifact,
+    X,
+    *,
+    shard_counts: list[int],
+    query_rows: int,
+    repeats: int,
+    max_batch_rows: int,
+) -> dict:
+    """Score one (query_rows, F) matrix through the bulk path at each shard
+    count and report rows/s (best of ``repeats``, after a full warmup pass
+    that absorbs the compiles). Every shard count must produce bit-identical
+    probabilities to the single-device path — the partitioner's contract —
+    and the record says so explicitly."""
+    import os
+
+    import numpy as np
+
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    reps = max(1, int(np.ceil(query_rows / X.shape[0])))
+    Xq = np.tile(np.nan_to_num(X, nan=0.0), (reps, 1))[:query_rows]
+    results: dict[str, dict] = {}
+    reference = None
+    for shards in shard_counts:
+        config = ServeConfig(
+            microbatch_enabled=False,
+            precompile_batch_buckets=(),
+            max_batch_rows=max_batch_rows,
+            bulk_shards=shards,
+            score_cache_size=0,
+        )
+        service = ScorerService(artifact, config)
+        actual = service._model.bulk_part.n_shards
+        print(
+            f"[bench] bulk shards={shards} (resolved {actual}): warmup + "
+            f"{repeats} timed passes over {query_rows} rows...",
+            file=sys.stderr,
+        )
+        probs = service.predict_proba(Xq)  # warmup pass pays the compiles
+        best_s = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            probs = service.predict_proba(Xq)
+            best_s = min(best_s, time.perf_counter() - t0)
+        if reference is None:
+            reference = probs
+        entry = {
+            "requested_shards": shards,
+            "shards": actual,
+            "rows_per_s": round(query_rows / best_s, 1),
+            "best_pass_ms": round(best_s * 1e3, 3),
+            "dispatches": int(
+                service.registry.snapshot()["cobalt_bulk_dispatches_total"][
+                    "samples"
+                ][0]["value"]
+            ),
+            "bit_identical_to_single": bool(
+                np.array_equal(reference, probs)
+            ),
+            "mesh": service._model.bulk_part.describe()["mesh"],
+        }
+        results[f"shards_{actual}"] = entry
+        service.close()
+    record = {
+        "bench": "bulk_scoring",
+        "query_rows": query_rows,
+        "max_batch_rows": max_batch_rows,
+        "platform": _platform_tag(),
+        "devices": _device_count(),
+        "host_cpu_cores": len(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity")
+        else (os.cpu_count() or 1),
+        "results": results,
+    }
+    keys = sorted(results, key=lambda k: results[k]["shards"])
+    if len(keys) >= 2:
+        base = results[keys[0]]["rows_per_s"]
+        top = results[keys[-1]]["rows_per_s"]
+        if base > 0:
+            record["speedup"] = round(top / base, 2)
+    record["bit_identical"] = all(
+        r["bit_identical_to_single"] for r in results.values()
+    )
+    return record
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--clients", type=int, default=32)
@@ -351,6 +454,21 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--microbatch-max-rows", type=int, default=None)
     parser.add_argument("--smoke", action="store_true",
                         help="CI profile: 4 clients, ~1s per mode")
+    parser.add_argument("--bulk", action="store_true",
+                        help="run the mesh-sharded bulk-scoring bench "
+                        "instead of the closed-loop single-row bench")
+    parser.add_argument("--bulk-rows", type=int, default=65536,
+                        help="rows in the bulk query matrix")
+    parser.add_argument("--bulk-shards", default="1,-1",
+                        help="comma-separated bulk_shards settings to "
+                        "compare (-1 = every visible device)")
+    parser.add_argument("--bulk-repeats", type=int, default=3,
+                        help="timed passes per shard count (best is kept)")
+    parser.add_argument("--max-batch-rows", type=int, default=4096,
+                        help="per-shard row cap of one compiled program")
+    parser.add_argument("--force-devices", type=int, default=None,
+                        help="set --xla_force_host_platform_device_count "
+                        "before JAX loads (no-op if JAX is already up)")
     parser.add_argument("--http-smoke", action="store_true",
                         help="also drive load over real HTTP and scrape "
                         "/metrics during it (validates the telemetry wiring; "
@@ -362,11 +480,49 @@ def main(argv: list[str] | None = None) -> int:
                         "Event / Perfetto JSON to this path (open in "
                         "ui.perfetto.dev; CI uploads it as an artifact)")
     args = parser.parse_args(argv)
+    if args.force_devices:
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.force_devices}"
+            ).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if args.smoke:
         args.clients = min(args.clients, 4)
         args.duration_s = min(args.duration_s, 1.0)
         args.warmup_s = min(args.warmup_s, 0.5)
         args.rows = min(args.rows, 800)
+        args.bulk_rows = min(args.bulk_rows, 16384)
+        args.bulk_repeats = min(args.bulk_repeats, 2)
+
+    if args.bulk:
+        print(f"[bench] training model ({args.rows} synthetic rows)...",
+              file=sys.stderr)
+        from cobalt_smart_lender_ai_tpu.config import ServeConfig
+
+        service, X = build_service(
+            ServeConfig(microbatch_enabled=False, precompile_batch_buckets=()),
+            n_rows=args.rows,
+        )
+        artifact = service.artifact
+        service.close()
+        record = run_bulk_bench(
+            artifact,
+            X,
+            shard_counts=[int(s) for s in args.bulk_shards.split(",")],
+            query_rows=args.bulk_rows,
+            repeats=args.bulk_repeats,
+            max_batch_rows=args.max_batch_rows,
+        )
+        line = json.dumps(record)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(line + "\n")
+        return 0
 
     from cobalt_smart_lender_ai_tpu.config import ServeConfig
 
@@ -486,6 +642,12 @@ def _platform_tag() -> str:
     import jax
 
     return jax.devices()[0].platform
+
+
+def _device_count() -> int:
+    import jax
+
+    return len(jax.devices())
 
 
 if __name__ == "__main__":

@@ -278,6 +278,29 @@ class ServeConfig:
     #: compile for an arbitrary batch bucket. ``None`` disables a bound.
     max_bulk_rows: int | None = 100_000
     max_bulk_bytes: int | None = 16 * 1024 * 1024
+    #: Row shards for bulk scoring (`parallel.partitioner`): the (N, F)
+    #: request matrix is sharded row-wise over a ``dp`` device mesh and ONE
+    #: sharded dispatch scores ``bulk_shards * bucket`` rows — replacing
+    #: ``bulk_shards`` sequential single-device dispatches. 0/1 = single
+    #: device (today's behavior); -1 = every visible device; N is clamped to
+    #: the visible device count. Single-row scoring and the micro-batcher
+    #: always stay single-device (their batches are too small to shard).
+    bulk_shards: int = 1
+    #: Shared-nothing `ScorerService` replicas behind the HTTP adapters
+    #: (`serve.replicas.ReplicaSet`): each replica owns its model programs,
+    #: micro-batcher, and metrics registry; a least-loaded router fans
+    #: requests out across them. 1 = the plain single-service path.
+    replicas: int = 1
+    #: Pin each replica's compiled programs to its own device (replica i ->
+    #: device i mod n_devices). On a single-device host all replicas share
+    #: the device and are thread-backed, which still overlaps host-side
+    #: work (validation, padding, serialization) with device dispatches.
+    replica_devices: bool = True
+    #: Content-hash score cache for repeated single-row payloads: bounded
+    #: LRU keyed on the canonicalized (F,) float32 feature vector's bytes,
+    #: hit/miss counters in the registry, invalidated on model reload.
+    #: 0 disables. Entries are O(F) floats — the default is ~1 MB.
+    score_cache_size: int = 2048
     #: Micro-batching inference scheduler (serve.service.MicroBatcher):
     #: concurrent ``predict_single`` callers are coalesced into ONE padded
     #: bucket dispatch instead of N serialized ``(1, F)`` device round-trips.

@@ -59,9 +59,10 @@ def _hist_kernel(bins_ref, rhs_ref, out_ref, *, n_bins: int, ft: int):
     R = b32.shape[0]
     n_tiles = b32.shape[1] // ft
     tile_cols = ft * n_bins
-    # pltpu.repeat tiles the block (f0 f1 f0 f1 ...), so the one-hot column
-    # layout is bin-major: col = bin * ft + f_local.
-    bin_id = jax.lax.broadcasted_iota(jnp.int32, (R, tile_cols), 1) // ft
+    # pltpu.repeat is element-wise (it lowers to jnp.repeat: f0 f0 ... f1 f1
+    # ...), so the one-hot column layout is feature-major:
+    # col = f_local * n_bins + bin.
+    bin_id = jax.lax.broadcasted_iota(jnp.int32, (R, tile_cols), 1) % n_bins
     rhs = rhs_ref[:]
     for t in range(n_tiles):  # static unroll: F_pad/ft tiles
         tile = b32[:, t * ft : (t + 1) * ft]  # (R, ft)
@@ -126,11 +127,11 @@ def hist_pallas(
         interpret=interpret,
     )(bins, rhs)
 
-    # Column layout: tile-major, then bin, then feature-within-tile (see the
+    # Column layout: tile-major, then feature-within-tile, then bin (see the
     # pltpu.repeat note in the kernel). C layout: channel-major [g|h|w] x K.
     n_tiles = F_pad // ft
-    arr = out.reshape(3, K, n_tiles, n_bins, ft)
-    arr = arr.transpose(1, 2, 4, 3, 0)  # (K, n_tiles, ft, B, 3)
+    arr = out.reshape(3, K, n_tiles, ft, n_bins)
+    arr = arr.transpose(1, 2, 3, 4, 0)  # (K, n_tiles, ft, B, 3)
     return arr.reshape(K, F_pad, n_bins, 3)[:, :F]
 
 

@@ -7,6 +7,13 @@ from cobalt_smart_lender_ai_tpu.parallel.distributed import (
     make_global_mesh,
 )
 from cobalt_smart_lender_ai_tpu.parallel.mesh import make_mesh, pad_rows
+from cobalt_smart_lender_ai_tpu.parallel.partitioner import (
+    MeshPartitioner,
+    Partitioner,
+    SingleDevicePartitioner,
+    make_partitioner,
+    match_partition_rule,
+)
 from cobalt_smart_lender_ai_tpu.parallel.rfe import RFEResult, rfe_select
 from cobalt_smart_lender_ai_tpu.parallel.sharded import fit_binned_dp, predict_margin_dp
 from cobalt_smart_lender_ai_tpu.parallel.tune import (
@@ -23,6 +30,11 @@ __all__ = [
     "init_distributed",
     "make_global_mesh",
     "make_mesh",
+    "make_partitioner",
+    "match_partition_rule",
+    "MeshPartitioner",
+    "Partitioner",
+    "SingleDevicePartitioner",
     "pad_rows",
     "fit_binned_dp",
     "predict_margin_dp",

@@ -9,6 +9,10 @@
   smoke-row validation and rollback. Concurrent single-row requests are
   coalesced by a `MicroBatcher` into one padded device dispatch per tick
   (README "Performance"; knobs on `ServeConfig.microbatch_*`).
+- `replicas` — multi-replica engine: N shared-nothing `ScorerService`
+  replicas (one per device, or thread-backed on CPU) behind a least-loaded
+  router presenting the same service surface, with ``cobalt_replica_*``
+  metrics and atomic all-replica hot reload (README "Scaling out").
 - `http_stdlib` — zero-dependency http.server adapter (this image has no
   fastapi); serves the same routes/status codes plus ``POST /admin/reload``.
 - `http_fastapi` — FastAPI adapter with the exact pydantic `SingleInput`
@@ -28,6 +32,10 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
     RequestError,
     RequestShed,
 )
+from cobalt_smart_lender_ai_tpu.serve.replicas import (
+    ReplicaSet,
+    resolve_replica_devices,
+)
 from cobalt_smart_lender_ai_tpu.serve.service import (
     SINGLE_INPUT_FIELDS,
     MicroBatcher,
@@ -42,9 +50,11 @@ __all__ = [
     "DeadlineExceeded",
     "MicroBatcher",
     "PayloadTooLarge",
+    "ReplicaSet",
     "RequestError",
     "RequestShed",
     "ScorerService",
     "ValidationError",
+    "resolve_replica_devices",
     "validate_single_input",
 ]

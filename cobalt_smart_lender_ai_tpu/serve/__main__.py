@@ -54,6 +54,33 @@ def main() -> None:
         "spikes on first hit of each smaller bucket)",
     )
     parser.add_argument(
+        "--replicas",
+        type=int,
+        default=ServeConfig.replicas,
+        help="shared-nothing serving replicas behind the least-loaded "
+        "router (one per device when pinning; README 'Scaling out')",
+    )
+    parser.add_argument(
+        "--no-replica-devices",
+        action="store_true",
+        help="do not pin replicas to devices round-robin; all replicas "
+        "share default placement (thread-backed, the CPU-host mode)",
+    )
+    parser.add_argument(
+        "--bulk-shards",
+        type=int,
+        default=ServeConfig.bulk_shards,
+        help="row shards per bulk dispatch: 0/1 single device, -1 every "
+        "visible device, N an N-way dp mesh (clamped to the host)",
+    )
+    parser.add_argument(
+        "--score-cache-size",
+        type=int,
+        default=ServeConfig.score_cache_size,
+        help="entries in the content-hash score cache for repeated "
+        "single-row payloads (0 disables)",
+    )
+    parser.add_argument(
         "--flight-slow-ms",
         type=float,
         default=ServeConfig.flight_slow_threshold_ms,
@@ -86,11 +113,27 @@ def main() -> None:
         microbatch_max_rows=args.microbatch_max_rows,
         prewarm_all_buckets=not args.no_prewarm,
         flight_slow_threshold_ms=args.flight_slow_ms,
+        replicas=args.replicas,
+        replica_devices=not args.no_replica_devices,
+        bulk_shards=args.bulk_shards,
+        score_cache_size=args.score_cache_size,
     )
-    service = ScorerService.from_store(ObjectStore(args.store), cfg)
+    # ReplicaSet.from_store returns a plain ScorerService at replicas<=1;
+    # both present the identical adapter surface.
+    from cobalt_smart_lender_ai_tpu.serve.replicas import ReplicaSet
+
+    service = ReplicaSet.from_store(ObjectStore(args.store), cfg)
     print(f"[INFO] model restored from {args.store}/{cfg.model_key}; "
           f"{len(service.feature_names)} features")
-    if service.batcher is not None:
+    if isinstance(service, ReplicaSet):
+        ready_payload = service.ready()[1]
+        print(f"[INFO] {len(service.replicas)} replicas behind the "
+              f"least-loaded router; devices: "
+              f"{ready_payload['replica_devices']}")
+    if cfg.bulk_shards not in (0, 1):
+        print(f"[INFO] bulk scoring sharded over the dp mesh "
+              f"(bulk_shards={cfg.bulk_shards})")
+    if cfg.microbatch_enabled:
         print(f"[INFO] micro-batching on: wait {cfg.microbatch_max_wait_ms}ms, "
               f"max {cfg.microbatch_max_rows} rows/dispatch"
               + ("" if args.no_prewarm else "; all buckets pre-warmed"))

@@ -1,0 +1,413 @@
+"""Multi-replica serving engine: N shared-nothing `ScorerService` replicas
+behind one service-shaped facade (README "Scaling out").
+
+One process, one accelerator is the shape `serve/service.py` hardened; this
+module is the shape that serves a portfolio. `ReplicaSet` spins up
+``ServeConfig.replicas`` full `ScorerService` instances — each with its own
+compiled programs, micro-batcher, metrics registry, and (with
+``replica_devices``, the default) its own device, assigned round-robin over
+``jax.devices()`` so an 8-chip host runs 8 pinned replicas; on a CPU host the
+replicas are thread-backed and share the one device. Nothing is shared
+between replicas but the artifact bytes they compiled from: no lock, queue,
+or cache crosses a replica boundary, so one replica stalling (a poisoned
+batch, a device hiccup) never convoys the others.
+
+Routing is least-loaded: every request picks the replica minimizing
+``in_flight + microbatch queue depth`` — the same two signals the telemetry
+gauges already export — with round-robin tie-breaking so an idle fleet still
+spreads warmup traffic. A stalled replica's in-flight count stays high, so
+the router organically drains around it (`tests/test_replicas.py`).
+
+The facade duck-types the full `ScorerService` surface the HTTP adapters
+bind to (`make_server(service)` / `create_app(service)` work unchanged):
+scoring endpoints route; `reload_from_store` is an atomic fleet swap — every
+replica builds + smoke-checks its candidate BEFORE any replica publishes, so
+a bad artifact rolls back everywhere and a good one lands everywhere;
+`/readyz` aggregates (ready iff every replica is ready) and reports the
+fleet shape; `/metrics` serves the facade registry, where the
+``cobalt_replica_*`` families break load, routing, and queue depth out per
+replica."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from cobalt_smart_lender_ai_tpu.config import ServeConfig
+from cobalt_smart_lender_ai_tpu.io.artifacts import GBDTArtifact
+from cobalt_smart_lender_ai_tpu.io.store import ObjectStore
+from cobalt_smart_lender_ai_tpu.reliability.admission import (
+    admission_from_config,
+)
+from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+from cobalt_smart_lender_ai_tpu.telemetry import (
+    FlightRecorder,
+    MetricsRegistry,
+    SLOEngine,
+    add_phase,
+    default_objectives,
+    default_tracer,
+    get_logger,
+)
+
+__all__ = ["ReplicaSet", "resolve_replica_devices"]
+
+_LOG = get_logger("serve.replicas")
+
+
+def resolve_replica_devices(
+    n_replicas: int, pin_devices: bool
+) -> list[Any | None]:
+    """Device assignment for ``n_replicas`` replicas: round-robin over the
+    visible devices when pinning (replica i -> devices[i % d], so 8 replicas
+    on a 4-chip host double up cleanly), or all-None (thread-backed, default
+    JAX placement) when ``pin_devices`` is off or there is only one device —
+    pinning everything to the one CPU device would only add placement
+    bookkeeping."""
+    import jax
+
+    devs = list(jax.devices())
+    if not pin_devices or len(devs) <= 1:
+        return [None] * n_replicas
+    return [devs[i % len(devs)] for i in range(n_replicas)]
+
+
+class ReplicaSet:
+    """N shared-nothing `ScorerService` replicas + a least-loaded router,
+    presenting the single-service surface both HTTP adapters bind to."""
+
+    def __init__(
+        self,
+        replicas: list[ScorerService],
+        config: ServeConfig,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if not replicas:
+            raise ValueError("ReplicaSet needs at least one replica")
+        self.replicas = replicas
+        self.config = config
+        self._clock = clock
+        # Router state: per-replica in-flight counts owned HERE (the facade
+        # brackets every routed call), so the load signal exists even for
+        # replicas whose batcher is disabled.
+        self._route_lock = threading.Lock()
+        self._inflight = [0] * len(replicas)
+        self._rr = 0  # round-robin tie-break cursor
+        # Fleet-level request surface: one admission controller gates the
+        # fleet's door (the adapters call ``admission.admit()`` once per
+        # request — per-replica admission would double-count), and the
+        # facade owns the flight recorder + SLO engine the debug endpoints
+        # read, fed by the same contextvar phase accumulators the replicas
+        # already write to.
+        self.admission = admission_from_config(config.reliability, clock=clock)
+        self.registry = MetricsRegistry()
+        self.flight = FlightRecorder(
+            capacity=config.flight_capacity,
+            slow_threshold_s=config.flight_slow_threshold_ms / 1000.0,
+            top_k=config.flight_top_k,
+        )
+        self.slo: SLOEngine | None = None
+        self._swap_lock = threading.Lock()
+        self._last_reload: dict | None = None
+        self._init_metrics()
+        if config.slo_enabled:
+            self.slo = SLOEngine(
+                self.registry,
+                default_objectives(config),
+                clock=clock,
+                windows_s=config.slo_windows_s,
+                fast_burn_threshold=config.slo_fast_burn_threshold,
+            )
+            self.slo.register_gauges()
+
+    @classmethod
+    def from_store(
+        cls,
+        store: ObjectStore,
+        config: ServeConfig | None = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> "ScorerService | ReplicaSet":
+        """Build the fleet from one restored artifact: the store is read
+        ONCE and every replica compiles from the same artifact bytes.
+        ``replicas <= 1`` returns a plain `ScorerService` — the facade adds
+        nothing when there is nothing to route between."""
+        cfg = config or ServeConfig()
+        n = max(1, int(cfg.replicas))
+        if n == 1:
+            return ScorerService.from_store(store, cfg, clock=clock)
+        devices = resolve_replica_devices(n, cfg.replica_devices)
+        first = ScorerService.from_store(
+            store, cfg, clock=clock, device=devices[0]
+        )
+        replicas = [first]
+        for i in range(1, n):
+            replicas.append(
+                ScorerService(
+                    first.artifact,
+                    cfg,
+                    store=store,
+                    clock=clock,
+                    device=devices[i],
+                )
+            )
+        return cls(replicas, cfg, clock=clock)
+
+    # -- metrics ---------------------------------------------------------------
+
+    def _init_metrics(self) -> None:
+        reg = self.registry
+        # Same request-level families the single service exports, so
+        # dashboards (and the SLO engine) work unchanged against a fleet.
+        self._m_latency = reg.histogram(
+            "cobalt_request_latency_seconds",
+            "request wall time by route and final HTTP status",
+            ("route", "status"),
+        )
+        self._m_phase = reg.histogram(
+            "cobalt_request_phase_seconds",
+            "request wall time attributed to each serving phase",
+            ("phase",),
+        )
+        self._m_errors = reg.counter(
+            "cobalt_request_errors_total",
+            "non-2xx responses by route and typed error code",
+            ("route", "code"),
+        )
+        adm = self.admission
+        reg.gauge(
+            "cobalt_admission_in_flight",
+            "scoring requests currently holding an admission slot",
+        ).set_function(lambda: adm.in_flight)
+        reg.counter(
+            "cobalt_admission_admitted_total",
+            "scoring requests admitted past both admission gates",
+        ).set_function(lambda: adm.admitted)
+        shed = reg.counter(
+            "cobalt_admission_shed_total",
+            "requests shed 429 at the door, by which gate refused them",
+            ("gate",),
+        )
+        shed.labels(gate="rate").set_function(lambda: adm.shed_rate)
+        shed.labels(gate="capacity").set_function(lambda: adm.shed_capacity)
+        # The per-replica break-out the ISSUE names: load, routing volume,
+        # and queue depth per replica — the router's own inputs, exported.
+        reg.gauge(
+            "cobalt_replica_count", "serving replicas behind the router"
+        ).set(len(self.replicas))
+        g_inflight = reg.gauge(
+            "cobalt_replica_in_flight",
+            "requests currently routed to (and not yet returned by) each "
+            "replica",
+            ("replica",),
+        )
+        g_queue = reg.gauge(
+            "cobalt_replica_queue_depth",
+            "each replica's micro-batch queue depth (0 when coalescing is "
+            "off)",
+            ("replica",),
+        )
+        self._m_routed = reg.counter(
+            "cobalt_replica_routed_total",
+            "requests the least-loaded router sent to each replica",
+            ("replica",),
+        )
+        self._m_reloads = reg.counter(
+            "cobalt_model_reloads_total",
+            "fleet-wide hot swap attempts by outcome (ok / rolled_back)",
+            ("status",),
+        )
+        for i, rep in enumerate(self.replicas):
+            g_inflight.labels(replica=str(i)).set_function(
+                lambda i=i: self._inflight[i]
+            )
+            g_queue.labels(replica=str(i)).set_function(
+                lambda r=rep: 0
+                if r.batcher is None
+                else r.batcher.queue_depth()
+            )
+
+    # -- routing ---------------------------------------------------------------
+
+    def _load_of(self, i: int) -> int:
+        rep = self.replicas[i]
+        queued = 0 if rep.batcher is None else rep.batcher.queue_depth()
+        return self._inflight[i] + queued
+
+    def _pick(self) -> int:
+        """Least-loaded replica index; round-robin among the tied so an idle
+        fleet still rotates (warm caches everywhere, not hotspot replica 0)."""
+        with self._route_lock:
+            n = len(self.replicas)
+            best, best_load = None, None
+            for off in range(n):
+                i = (self._rr + off) % n
+                load = self._load_of(i)
+                if best_load is None or load < best_load:
+                    best, best_load = i, load
+            self._rr = (best + 1) % n
+            self._inflight[best] += 1
+        self._m_routed.labels(replica=str(best)).inc()
+        return best
+
+    @contextlib.contextmanager
+    def _routed(self):
+        i = self._pick()
+        try:
+            with default_tracer().span("serve.route", replica=i):
+                yield self.replicas[i]
+        finally:
+            with self._route_lock:
+                self._inflight[i] -= 1
+
+    # -- the adapter-facing surface --------------------------------------------
+
+    def predict_single(
+        self, payload: Mapping[str, Any], *, deadline=None
+    ) -> dict:
+        with self._routed() as rep:
+            return rep.predict_single(payload, deadline=deadline)
+
+    def predict_bulk_csv(self, csv_bytes: bytes, *, deadline=None) -> dict:
+        with self._routed() as rep:
+            return rep.predict_bulk_csv(csv_bytes, deadline=deadline)
+
+    def feature_importance_bulk(
+        self, payload: Mapping[str, Any], *, deadline=None
+    ) -> dict:
+        with self._routed() as rep:
+            return rep.feature_importance_bulk(payload, deadline=deadline)
+
+    def predict_proba(self, X: np.ndarray, deadline=None) -> np.ndarray:
+        with self._routed() as rep:
+            return rep.predict_proba(X, deadline=deadline)
+
+    def shap_bulk(self, X: np.ndarray, deadline=None):
+        with self._routed() as rep:
+            return rep.shap_bulk(X, deadline=deadline)
+
+    # -- observability hooks the adapters call ---------------------------------
+
+    def observe_request(
+        self,
+        route: str,
+        status: int,
+        duration_s: float,
+        code: str | None = None,
+        trace_id: int | str | None = None,
+    ) -> None:
+        self._m_latency.labels(route=route, status=str(status)).observe(
+            max(0.0, duration_s),
+            exemplar=None if trace_id is None else str(trace_id),
+        )
+        if status >= 400:
+            self._m_errors.labels(route=route, code=code or "error").inc()
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        try:
+            with default_tracer().span(f"serve.{name}") as sp:
+                yield sp
+        finally:
+            duration_s = max(0.0, sp.duration_s or 0.0)
+            self._m_phase.labels(phase=name).observe(duration_s)
+            add_phase(name, duration_s)
+
+    # -- lifecycle / fleet management ------------------------------------------
+
+    @property
+    def artifact(self) -> GBDTArtifact:
+        return self.replicas[0].artifact
+
+    @property
+    def feature_names(self) -> list[str]:
+        return self.replicas[0].feature_names
+
+    def health(self) -> dict:
+        return {"status": "ok"}
+
+    def ready(self) -> tuple[bool, dict]:
+        """Fleet readiness: ready iff EVERY replica is ready (a fleet that
+        routes 1/N of traffic into an unready replica is not ready), with
+        the per-replica payloads nested for drill-down and the fleet shape
+        — replica count, device pinning, mesh — at the top for the CI
+        bulk-smoke assert."""
+        per = [rep.ready() for rep in self.replicas]
+        all_ready = all(ok for ok, _ in per)
+        payload = {
+            "status": "ok" if all_ready else "unavailable",
+            "replicas": len(self.replicas),
+            "replica_devices": [
+                None if rep._device is None else str(rep._device)
+                for rep in self.replicas
+            ],
+            "router": {
+                "policy": "least_loaded",
+                "in_flight": list(self._inflight),
+            },
+            "bulk": per[0][1].get("bulk"),
+            "admission": self.admission.stats(),
+            "per_replica": [p for _, p in per],
+        }
+        if self._last_reload is not None:
+            payload["last_reload"] = self._last_reload
+        return all_ready, payload
+
+    def reload_from_store(
+        self,
+        store: ObjectStore | None = None,
+        model_key: str | None = None,
+    ) -> dict:
+        """Atomic fleet swap: every replica restores + compiles +
+        smoke-checks its candidate FIRST; only when all N candidates are
+        valid does any replica publish. A failure anywhere rolls back
+        everywhere (nothing was published), so the fleet never serves mixed
+        model versions across replicas."""
+        with self._swap_lock:
+            key = model_key or self.replicas[0]._model_key
+            candidates = []
+            try:
+                for rep in self.replicas:
+                    s = store if store is not None else rep._store
+                    if s is None:
+                        raise RuntimeError(
+                            "no store bound: construct the fleet with "
+                            "from_store() or pass store= explicitly"
+                        )
+                    candidates.append(rep._build_candidate(s, key))
+            except Exception as exc:
+                from cobalt_smart_lender_ai_tpu.reliability.errors import (
+                    CircuitOpenError,
+                )
+
+                if isinstance(exc, CircuitOpenError):
+                    raise
+                self._last_reload = {
+                    "status": "rolled_back",
+                    "model_key": key,
+                    "replicas": len(self.replicas),
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+                self._m_reloads.labels(status="rolled_back").inc()
+                _LOG.warning("fleet_reload", **self._last_reload)
+                return self._last_reload
+            for rep, cand in zip(self.replicas, candidates):
+                rep._publish_candidate(cand, key)
+            self._last_reload = {
+                "status": "ok",
+                "model_key": key,
+                "replicas": len(self.replicas),
+                "n_features": candidates[0].n_features,
+            }
+            self._m_reloads.labels(status="ok").inc()
+            _LOG.info("fleet_reload", **self._last_reload)
+            return self._last_reload
+
+    def close(self) -> None:
+        for rep in self.replicas:
+            rep.close()

@@ -32,10 +32,20 @@ row. N concurrent users cost one amortized device round-trip instead of N
 serialized `(1, F)` dispatches with full dispatch overhead each — the
 serving-side analogue of the training stack amortizing histogram passes
 (`bench_serve.py` measures the difference; README "Performance").
+
+Bulk scoring is mesh-sharded (`parallel.partitioner`, README "Scaling
+out"): with ``ServeConfig.bulk_shards > 1`` the (N, F) request matrix is
+sharded row-wise over a ``dp`` device mesh and ONE `shard_map` dispatch
+scores ``bulk_shards * bucket`` rows — bit-identical to the single-device
+path (per-row tree descent has no cross-row reductions) and measured by
+``bench_serve.py --bulk`` into ``BENCH_BULK_*.json``. Repeated single-row
+payloads short-circuit through a content-hash LRU score cache
+(``score_cache_size``), invalidated on every hot model swap.
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import io as _io
 import math
@@ -51,11 +61,11 @@ import pandas as pd
 
 from cobalt_smart_lender_ai_tpu.config import ServeConfig
 from cobalt_smart_lender_ai_tpu.data import schema
-from cobalt_smart_lender_ai_tpu.explain.treeshap import shap_values
 from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
-from cobalt_smart_lender_ai_tpu.models.gbdt import (
-    gain_importances,
-    predict_margin,
+from cobalt_smart_lender_ai_tpu.models.gbdt import gain_importances
+from cobalt_smart_lender_ai_tpu.parallel.partitioner import (
+    SingleDevicePartitioner,
+    make_partitioner,
 )
 from cobalt_smart_lender_ai_tpu.reliability.admission import (
     admission_from_config,
@@ -157,9 +167,16 @@ class _CompiledModel:
     is built completely off to the side and only published once validated.
     """
 
-    def __init__(self, artifact: GBDTArtifact, config: ServeConfig):
+    def __init__(
+        self,
+        artifact: GBDTArtifact,
+        config: ServeConfig,
+        *,
+        device: Any | None = None,
+    ):
         self.artifact = artifact
         self.config = config
+        self.device = device
         self.feature_names = list(artifact.feature_names)
         self.n_features = len(self.feature_names)
         # name -> column dict built once per model, so request-row assembly
@@ -167,11 +184,16 @@ class _CompiledModel:
         self._feature_index = {n: i for i, n in enumerate(self.feature_names)}
         forest = artifact.forest
         self.forest = forest
+        # Where the programs run (README "Scaling out"): `local` compiles
+        # the per-request and single-device programs — pinned to ``device``
+        # when the replica engine places each shared-nothing replica on its
+        # own accelerator — and `bulk_part` decides whether bulk scoring
+        # shards rows over a ``dp`` mesh (``ServeConfig.bulk_shards``).
+        self.local = SingleDevicePartitioner(device)
+        self.bulk_part = make_partitioner(config.bulk_shards, device=device)
         # Pre-compile both device programs at startup (the reference builds
         # its TreeExplainer in the lifespan hook for the same reason).
-        self.margin_fn = jax.jit(lambda X: predict_margin(forest, X)).lower(
-            jax.ShapeDtypeStruct((1, self.n_features), jnp.float32)
-        ).compile()
+        self.margin_fn = self.local.compile_margin(forest, self.n_features, 1)
         # SHAP is the one *optional* device program: probabilities are the
         # service's contract, attributions are an enrichment. With
         # `reliability.degrade_shap` (default), a SHAP compile failure leaves
@@ -181,11 +203,7 @@ class _CompiledModel:
         self.shap_fn = None
         self.shap_error: str | None = None
         try:
-            self.shap_fn = jax.jit(
-                lambda X: shap_values(forest, X, n_features=self.n_features)
-            ).lower(
-                jax.ShapeDtypeStruct((1, self.n_features), jnp.float32)
-            ).compile()
+            self.shap_fn = self.local.compile_shap(forest, self.n_features, 1)
         except Exception as exc:
             if not config.reliability.degrade_shap:
                 raise
@@ -201,6 +219,12 @@ class _CompiledModel:
         self.shap_bucket_fns: dict[int, Any] = (
             {} if self.shap_fn is None else {1: self.shap_fn}
         )
+        # Mesh-sharded bulk programs (``bulk_shards > 1``), keyed by the
+        # PER-SHARD row bucket and compiled lazily on first use. Off-mesh
+        # bulk scoring keeps sharing `bucket_fns`, so the observable
+        # ``compiled_batch_buckets`` contract is unchanged on one device.
+        self.bulk_fns: dict[int, Any] = {}
+        self.bulk_shap_fns: dict[int, Any] = {}
         for b in config.precompile_batch_buckets:
             self.margin_for_bucket(self.bucket_of(b))
         # Warm the micro-batcher's coalescable buckets too — margin AND
@@ -238,15 +262,8 @@ class _CompiledModel:
             with self._bucket_lock:
                 fn = self.bucket_fns.get(bucket)
                 if fn is None:
-                    forest = self.forest
-                    fn = (
-                        jax.jit(lambda X: predict_margin(forest, X))
-                        .lower(
-                            jax.ShapeDtypeStruct(
-                                (bucket, self.n_features), jnp.float32
-                            )
-                        )
-                        .compile()
+                    fn = self.local.compile_margin(
+                        self.forest, self.n_features, bucket
                     )
                     self.bucket_fns[bucket] = fn
         return fn
@@ -266,16 +283,9 @@ class _CompiledModel:
             with self._bucket_lock:
                 fn = self.shap_bucket_fns.get(bucket)
                 if fn is None:
-                    forest, n = self.forest, self.n_features
                     try:
-                        fn = (
-                            jax.jit(
-                                lambda X: shap_values(forest, X, n_features=n)
-                            )
-                            .lower(
-                                jax.ShapeDtypeStruct((bucket, n), jnp.float32)
-                            )
-                            .compile()
+                        fn = self.local.compile_shap(
+                            self.forest, self.n_features, bucket
                         )
                     except Exception as exc:
                         if not self.config.reliability.degrade_shap:
@@ -303,21 +313,67 @@ class _CompiledModel:
     def row_array(self, row: Mapping[str, float]) -> np.ndarray:
         return self.rows_array([row])
 
-    def predict_proba(
-        self, X: np.ndarray, deadline: Deadline | None = None
-    ) -> np.ndarray:
-        """P(default) for an (N, F) float array — `predict_proba_df`
-        (cobalt_fast_api.py:90-91). Rows are chunked to ``max_batch_rows``
-        and each chunk zero-padded to its power-of-two bucket, so any
-        request sequence hits at most log2(max_batch_rows) compiles. The
-        deadline (when given) is checked before each chunk — the cooperative
-        cancellation point of the bulk path."""
-        X = np.asarray(X, dtype=np.float32)
+    def bulk_margin_for_bucket(self, bucket: int):
+        """Compiled bulk-margin program scoring ``bucket * n_shards`` rows
+        per dispatch: `margin_for_bucket` itself off-mesh (one cache, one
+        contract), a row-sharded `shard_map` program on a mesh."""
+        part = self.bulk_part
+        if part.n_shards == 1:
+            return self.margin_for_bucket(bucket)
+        fn = self.bulk_fns.get(bucket)
+        if fn is None:
+            with self._bucket_lock:
+                fn = self.bulk_fns.get(bucket)
+                if fn is None:
+                    fn = part.compile_margin(
+                        self.forest, self.n_features, bucket * part.n_shards
+                    )
+                    self.bulk_fns[bucket] = fn
+        return fn
+
+    def bulk_shap_for_bucket(self, bucket: int):
+        """Sharded analogue of `shap_for_bucket`; ``None`` while SHAP is
+        degraded, and a failed mesh compile degrades SHAP the same way a
+        failed single-device bucket compile does."""
+        part = self.bulk_part
+        if part.n_shards == 1:
+            return self.shap_for_bucket(bucket)
+        if self.shap_fn is None:
+            return None
+        fn = self.bulk_shap_fns.get(bucket)
+        if fn is None:
+            with self._bucket_lock:
+                fn = self.bulk_shap_fns.get(bucket)
+                if fn is None:
+                    try:
+                        fn = part.compile_shap(
+                            self.forest, self.n_features, bucket * part.n_shards
+                        )
+                    except Exception as exc:
+                        if not self.config.reliability.degrade_shap:
+                            raise
+                        self.shap_error = f"{type(exc).__name__}: {exc}"
+                        self.shap_fn = None
+                        self.shap_bucket_fns = {}
+                        self.bulk_shap_fns = {}
+                        return None
+                    self.bulk_shap_fns[bucket] = fn
+        return fn
+
+    def _bulk_chunks(self, X: np.ndarray, deadline: Deadline | None):
+        """Shared chunking protocol of the bulk path: yield
+        ``(start, n, bucket, padded_chunk)`` with rows chunked to
+        ``max_batch_rows * n_shards`` and each chunk zero-padded to
+        ``bucket * n_shards`` — ``bucket`` the power-of-two cover of the
+        PER-SHARD row count, so lifetime compiles stay bounded by
+        log2(max_batch_rows) regardless of mesh size. The deadline (when
+        given) is checked before each chunk — the cooperative cancellation
+        point between device dispatches."""
         N = X.shape[0]
-        out = np.empty((N,), dtype=np.float32)
-        step = self.config.max_batch_rows
+        shards = self.bulk_part.n_shards
+        step = self.config.max_batch_rows * shards
         # Padding scratch, allocated at most once per call (NOT shared on the
-        # model: predict_proba runs concurrently across request threads) and
+        # model: bulk calls run concurrently across request threads) and
         # reused across chunks instead of np.concatenate building a fresh
         # padded array per chunk.
         scratch: np.ndarray | None = None
@@ -326,17 +382,83 @@ class _CompiledModel:
                 deadline.check(f"bulk scoring, row {start}/{N}")
             chunk = X[start : start + step]
             n = chunk.shape[0]
-            bucket = self.bucket_of(n)
-            if n < bucket:
-                if scratch is None or scratch.shape[0] < bucket:
-                    scratch = np.zeros((bucket, X.shape[1]), np.float32)
-                padded = scratch[:bucket]
+            bucket = self.bucket_of(-(-n // shards))
+            total = bucket * shards
+            if n < total:
+                if scratch is None or scratch.shape[0] < total:
+                    scratch = np.zeros((total, X.shape[1]), np.float32)
+                padded = scratch[:total]
                 padded[:n] = chunk
                 padded[n:] = 0.0
                 chunk = padded
-            margin = self.margin_for_bucket(bucket)(jnp.asarray(chunk))
-            out[start : start + n] = np.asarray(jax.nn.sigmoid(margin))[:n]
+            yield start, n, bucket, chunk
+
+    def predict_margin_bulk(
+        self,
+        X: np.ndarray,
+        deadline: Deadline | None = None,
+        on_dispatch: Callable[[int, float], None] | None = None,
+    ) -> np.ndarray:
+        """Raw forest margins for an (N, F) float array through the bulk
+        partitioner — ONE (possibly mesh-sharded) dispatch per chunk.
+        ``on_dispatch(rows, seconds)`` feeds the service's bulk-throughput
+        metrics without the model knowing about the registry."""
+        X = np.asarray(X, dtype=np.float32)
+        out = np.empty((X.shape[0],), dtype=np.float32)
+        for start, n, bucket, chunk in self._bulk_chunks(X, deadline):
+            t0 = time.monotonic()
+            # np input: the compiled executable places rows on its own
+            # device(s) — mesh-sharded or replica-pinned — where a jnp
+            # conversion here would commit them to the process default.
+            margin = self.bulk_margin_for_bucket(bucket)(chunk)
+            out[start : start + n] = np.asarray(margin)[:n]
+            if on_dispatch is not None:
+                on_dispatch(n, time.monotonic() - t0)
         return out
+
+    def predict_proba(
+        self,
+        X: np.ndarray,
+        deadline: Deadline | None = None,
+        on_dispatch: Callable[[int, float], None] | None = None,
+    ) -> np.ndarray:
+        """P(default) for an (N, F) float array — `predict_proba_df`
+        (cobalt_fast_api.py:90-91): margins via `predict_margin_bulk`, then
+        ONE host-side logistic over the collected vector. Every partitioner
+        funnels through this same numpy sigmoid, so mesh and single-device
+        bulk scores are bit-identical (the margins already are: a row's
+        tree descent has no cross-row reductions), which
+        `tests/test_partitioner.py` locks in."""
+        margins = self.predict_margin_bulk(X, deadline, on_dispatch)
+        with np.errstate(over="ignore"):  # exp overflow saturates to p=0.0
+            return 1.0 / (1.0 + np.exp(-margins))
+
+    def shap_bulk(
+        self,
+        X: np.ndarray,
+        deadline: Deadline | None = None,
+        on_dispatch: Callable[[int, float], None] | None = None,
+    ) -> tuple[np.ndarray, float] | None:
+        """Bulk SHAP through the bulk partitioner: ``((N, F) contributions,
+        base_value)``, or ``None`` while SHAP is degraded — same chunking /
+        padding / deadline protocol as `predict_margin_bulk`, one sharded
+        dispatch per chunk."""
+        if self.shap_fn is None:
+            return None
+        X = np.asarray(X, dtype=np.float32)
+        phis = np.empty((X.shape[0], self.n_features), dtype=np.float32)
+        base = 0.0
+        for start, n, bucket, chunk in self._bulk_chunks(X, deadline):
+            fn = self.bulk_shap_for_bucket(bucket)
+            if fn is None:
+                return None  # degraded mid-call: no partial attributions
+            t0 = time.monotonic()
+            phis_chunk, base_v = fn(chunk)
+            phis[start : start + n] = np.asarray(phis_chunk)[:n]
+            base = float(base_v)
+            if on_dispatch is not None:
+                on_dispatch(n, time.monotonic() - t0)
+        return phis, base
 
 
 class MicroBatcher:
@@ -600,7 +722,10 @@ class MicroBatcher:
             with default_tracer().span(
                 "serve.dispatch", rows=n, bucket=bucket
             ) as d_sp:
-                xb = jnp.asarray(buf)
+                # np input, not jnp.asarray: the compiled program places the
+                # batch on its own device, so a pinned replica's batcher
+                # never routes rows through the process default device.
+                xb = buf
                 probs = np.asarray(
                     jax.nn.sigmoid(model.margin_for_bucket(bucket)(xb))
                 )[:n]
@@ -667,11 +792,15 @@ class ScorerService:
         clock: Callable[[], float] = time.monotonic,
         breaker: CircuitBreaker | None = None,
         registry: MetricsRegistry | None = None,
+        device: Any | None = None,
     ):
         self.config = config or ServeConfig()
         self._clock = clock
         self._store = store
         self._model_key = self.config.model_key
+        # Replica pinning (serve/replicas.py): every program this service
+        # compiles — including hot-swap candidates — lands on this device.
+        self._device = device
         # Fresh registry per service by default: a service owns its metric
         # cells the way it owns its admission counters, so two services in
         # one process (tests, bench A/B modes) never share counts. Pass
@@ -681,6 +810,15 @@ class ScorerService:
         rel = self.config.reliability
         self.store_breaker = breaker or breaker_from_config(rel, clock=clock)
         self.admission = admission_from_config(rel, clock=clock)
+        # Content-hash score cache (ROADMAP item 4's remaining cheap win):
+        # repeated single-row payloads short-circuit to the response last
+        # computed for the identical canonicalized feature vector. Bounded
+        # LRU, invalidated wholesale on every hot swap — a cached score is a
+        # fingerprint of the model that produced it.
+        self._score_cache: "collections.OrderedDict[bytes, tuple]" = (
+            collections.OrderedDict()
+        )
+        self._score_cache_lock = threading.Lock()
         self._init_metrics()
         # Tail-latency forensics (README "Debugging tail latency"): the
         # flight recorder and SLO engine live next to the registry — a
@@ -704,7 +842,7 @@ class ScorerService:
         # read `_model` once and run against that snapshot.
         self._swap_lock = threading.Lock()
         self._last_reload: dict | None = None
-        self._model = _CompiledModel(artifact, self.config)
+        self._model = _CompiledModel(artifact, self.config, device=device)
         self.batcher: MicroBatcher | None = None
         if self.config.microbatch_enabled:
             self.batcher = MicroBatcher(
@@ -785,6 +923,36 @@ class ScorerService:
             "cobalt_breaker_fast_failures_total",
             "store calls rejected while the circuit was open",
         ).set_function(lambda: brk.fast_failures)
+        # Bulk (mesh-sharded) scoring throughput — `bench_serve.py --bulk`
+        # and the CI bulk-smoke job read rows/s off these two counters.
+        self._m_bulk_rows = reg.counter(
+            "cobalt_bulk_rows_total",
+            "rows scored through the bulk (sharded) scoring path",
+        )
+        self._m_bulk_dispatches = reg.counter(
+            "cobalt_bulk_dispatches_total",
+            "device dispatches issued by the bulk scoring path",
+        )
+        self._m_bulk_dispatch_s = reg.histogram(
+            "cobalt_bulk_dispatch_seconds",
+            "wall time of one (possibly mesh-sharded) bulk dispatch",
+        )
+        reg.gauge(
+            "cobalt_bulk_shards",
+            "row shards per bulk dispatch (1 = single device)",
+        ).set_function(lambda: self._model.bulk_part.n_shards)
+        self._m_cache_hits = reg.counter(
+            "cobalt_score_cache_hits_total",
+            "single-row requests answered from the content-hash score cache",
+        )
+        self._m_cache_misses = reg.counter(
+            "cobalt_score_cache_misses_total",
+            "score-cache lookups that fell through to a device dispatch",
+        )
+        reg.gauge(
+            "cobalt_score_cache_entries",
+            "entries currently held by the content-hash score cache",
+        ).set_function(lambda: len(self._score_cache))
 
     def observe_request(
         self,
@@ -894,6 +1062,7 @@ class ScorerService:
         *,
         clock: Callable[[], float] = time.monotonic,
         registry: MetricsRegistry | None = None,
+        device: Any | None = None,
     ) -> "ScorerService":
         """Startup restore — the lifespan S3 download + joblib.load of
         `cobalt_fast_api.py:42-47`, run under the circuit breaker so a dead
@@ -909,6 +1078,7 @@ class ScorerService:
             clock=clock,
             breaker=brk,
             registry=registry,
+            device=device,
         )
 
     # -- hot model swap --------------------------------------------------------
@@ -931,7 +1101,7 @@ class ScorerService:
                 f"{sorted(set(candidate.feature_names) ^ set(current.feature_names))[:4]})"
             )
         x = np.zeros((1, candidate.n_features), dtype=np.float32)
-        prob = float(jax.nn.sigmoid(candidate.margin_fn(jnp.asarray(x)))[0])
+        prob = float(jax.nn.sigmoid(candidate.margin_fn(x))[0])
         if not (math.isfinite(prob) and 0.0 <= prob <= 1.0):
             raise ValueError(f"smoke row scored {prob!r}, expected [0, 1]")
 
@@ -960,11 +1130,7 @@ class ScorerService:
         key = model_key or self._model_key
         with self._swap_lock:
             try:
-                artifact = self.store_breaker.call(
-                    lambda: GBDTArtifact.load(store, key)
-                )
-                candidate = _CompiledModel(artifact, self.config)
-                self._smoke_check(candidate)
+                candidate = self._build_candidate(store, key)
             except Exception as exc:
                 from cobalt_smart_lender_ai_tpu.reliability.errors import (
                     CircuitOpenError,
@@ -972,35 +1138,59 @@ class ScorerService:
 
                 if isinstance(exc, CircuitOpenError):
                     raise
-                self._last_reload = {
-                    "status": "rolled_back",
-                    "model_key": key,
-                    "error": f"{type(exc).__name__}: {exc}",
-                }
-                self._m_reloads.labels(status="rolled_back").inc()
-                _LOG.warning("model_reload", **self._last_reload)
-                return self._last_reload
-            # Publish under the batcher's dispatch lock: the in-flight batch
-            # (which snapshotted the old _CompiledModel) drains fully before
-            # the reference swap, so no batch ever mixes models; the next
-            # batch snapshots the candidate, whose batch buckets were warmed
-            # at construction above.
-            publish_gate = (
-                self.batcher.pause()
-                if self.batcher is not None
-                else contextlib.nullcontext()
-            )
-            with publish_gate:
-                self._model = candidate  # the atomic swap
-            self._model_key = key
-            self._last_reload = {
-                "status": "ok",
-                "model_key": key,
-                "n_features": candidate.n_features,
-            }
-            self._m_reloads.labels(status="ok").inc()
-            _LOG.info("model_reload", **self._last_reload)
-            return self._last_reload
+                return self._record_rollback(key, exc)
+            return self._publish_candidate(candidate, key)
+
+    def _build_candidate(self, store: ObjectStore, key: str) -> _CompiledModel:
+        """Restore + compile + smoke-check a candidate model off to the side
+        — everything a swap does EXCEPT publishing. The replica engine
+        (serve/replicas.py) builds one candidate per replica through this
+        hook before committing any of them, so an all-replica reload is
+        all-or-nothing."""
+        artifact = self.store_breaker.call(
+            lambda: GBDTArtifact.load(store, key)
+        )
+        candidate = _CompiledModel(artifact, self.config, device=self._device)
+        self._smoke_check(candidate)
+        return candidate
+
+    def _publish_candidate(self, candidate: _CompiledModel, key: str) -> dict:
+        """Atomically publish a validated candidate.
+
+        Publish under the batcher's dispatch lock: the in-flight batch
+        (which snapshotted the old _CompiledModel) drains fully before the
+        reference swap, so no batch ever mixes models; the next batch
+        snapshots the candidate, whose batch buckets were warmed at
+        construction. The score cache empties in the same breath — its
+        entries fingerprint the model that is leaving."""
+        publish_gate = (
+            self.batcher.pause()
+            if self.batcher is not None
+            else contextlib.nullcontext()
+        )
+        with publish_gate:
+            self._model = candidate  # the atomic swap
+        with self._score_cache_lock:
+            self._score_cache.clear()
+        self._model_key = key
+        self._last_reload = {
+            "status": "ok",
+            "model_key": key,
+            "n_features": candidate.n_features,
+        }
+        self._m_reloads.labels(status="ok").inc()
+        _LOG.info("model_reload", **self._last_reload)
+        return self._last_reload
+
+    def _record_rollback(self, key: str, exc: Exception) -> dict:
+        self._last_reload = {
+            "status": "rolled_back",
+            "model_key": key,
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+        self._m_reloads.labels(status="rolled_back").inc()
+        _LOG.warning("model_reload", **self._last_reload)
+        return self._last_reload
 
     # -- scoring helpers ------------------------------------------------------
 
@@ -1012,7 +1202,62 @@ class ScorerService:
     def predict_proba(
         self, X: np.ndarray, deadline: Deadline | None = None
     ) -> np.ndarray:
-        return self._model.predict_proba(X, deadline)
+        """Bulk scores through the model's bulk partitioner, with per-
+        dispatch throughput recorded into the ``cobalt_bulk_*`` families."""
+        model = self._model
+        X = np.asarray(X, dtype=np.float32)
+        with default_tracer().span(
+            "serve.bulk_score",
+            rows=int(X.shape[0]),
+            shards=model.bulk_part.n_shards,
+        ):
+            return model.predict_proba(X, deadline, self._observe_bulk_dispatch)
+
+    def shap_bulk(
+        self, X: np.ndarray, deadline: Deadline | None = None
+    ) -> tuple[np.ndarray, float] | None:
+        """Bulk SHAP contributions through the bulk partitioner (``None``
+        while SHAP is degraded) — the offline batch-explain entry point."""
+        model = self._model
+        X = np.asarray(X, dtype=np.float32)
+        with default_tracer().span(
+            "serve.bulk_shap",
+            rows=int(X.shape[0]),
+            shards=model.bulk_part.n_shards,
+        ):
+            return model.shap_bulk(X, deadline, self._observe_bulk_dispatch)
+
+    def _observe_bulk_dispatch(self, rows: int, seconds: float) -> None:
+        self._m_bulk_rows.inc(rows)
+        self._m_bulk_dispatches.inc()
+        self._m_bulk_dispatch_s.observe(max(0.0, seconds))
+
+    # -- content-hash score cache ---------------------------------------------
+
+    def _score_cache_get(self, key: bytes):
+        with self._score_cache_lock:
+            value = self._score_cache.get(key)
+            if value is not None:
+                self._score_cache.move_to_end(key)  # LRU touch
+            return value
+
+    def _score_cache_put(self, key: bytes, value: tuple, model=None) -> None:
+        size = self.config.score_cache_size
+        if size <= 0:
+            return
+        with self._score_cache_lock:
+            # A hot swap publishes the candidate and clears the cache; a
+            # request that scored against the outgoing model may only reach
+            # this put afterwards. Its value must not outlive the swap, so
+            # the write is fenced on the model it was computed from still
+            # being the published one (checked under the same lock the swap
+            # clears under).
+            if model is not None and model is not self._model:
+                return
+            self._score_cache[key] = value
+            self._score_cache.move_to_end(key)
+            while len(self._score_cache) > size:
+                self._score_cache.popitem(last=False)
 
     # -- health / readiness ---------------------------------------------------
 
@@ -1042,6 +1287,22 @@ class ScorerService:
             "degraded": model.shap_fn is None,
             "breaker": self.store_breaker.state,
             "admission": self.admission.stats(),
+            # Mesh/shard shape of the bulk path plus the sharded programs
+            # already compiled — the CI bulk-smoke job asserts this block.
+            "bulk": {
+                **model.bulk_part.describe(),
+                "compiled_buckets": (
+                    sorted(model.bulk_fns)
+                    if model.bulk_part.n_shards > 1
+                    else list(self.compiled_batch_buckets)
+                ),
+            },
+            "score_cache": {
+                "size": self.config.score_cache_size,
+                "entries": len(self._score_cache),
+                "hits": int(self._m_cache_hits.value),
+                "misses": int(self._m_cache_misses.value),
+            },
             "microbatch": (
                 {"enabled": False}
                 if self.batcher is None
@@ -1074,6 +1335,28 @@ class ScorerService:
             row = validate_single_input(payload)
             if dl is not None:
                 dl.check("input validated")
+        cache_key: bytes | None = None
+        cache_model = None
+        if self.config.score_cache_size > 0:
+            # Content hash = the canonicalized (1, F) float32 vector's raw
+            # bytes: two payloads that validate to the same features ARE the
+            # same request, whatever their key order, aliases, or int/float
+            # spelling. Only full (non-degraded) responses are cached, so a
+            # hit always carries attributions.
+            cache_model = model = self._model
+            cache_key = model.rows_array([row]).tobytes()
+            cached = self._score_cache_get(cache_key)
+            if cached is not None:
+                self._m_cache_hits.inc()
+                prob, phis_row, base = cached
+                return {
+                    "prob_default": prob,
+                    "features": list(model.feature_names),
+                    "input_row": dict(row),
+                    "shap_values": list(phis_row),
+                    "base_value": base,
+                }
+            self._m_cache_misses.inc()
         batcher = self.batcher
         fut = None
         if batcher is not None and not batcher.closed:
@@ -1109,11 +1392,17 @@ class ScorerService:
                 resp["base_value"] = None
                 resp["degraded"] = True
                 self._m_shap_degraded.inc()
+            if cache_key is not None and resp.get("shap_values") is not None:
+                self._score_cache_put(
+                    cache_key,
+                    (resp["prob_default"], resp["shap_values"], resp["base_value"]),
+                    model=cache_model,
+                )
             return resp
         model = self._model
         with self.phase("dispatch"):
             x = model.row_array(row)
-            margin = model.margin_fn(jnp.asarray(x))
+            margin = model.margin_fn(x)
             prob = float(jax.nn.sigmoid(margin)[0])
         resp = {
             "prob_default": prob,
@@ -1135,7 +1424,7 @@ class ScorerService:
             if model.shap_fn is None:
                 raise RuntimeError(model.shap_error or "SHAP program unavailable")
             with self.phase("shap"):
-                phis, base = model.shap_fn(jnp.asarray(x))
+                phis, base = model.shap_fn(x)
             resp["shap_values"] = np.asarray(phis)[0].tolist()
             resp["base_value"] = float(base)
         except DeadlineExceeded:
@@ -1151,6 +1440,12 @@ class ScorerService:
             resp["base_value"] = None
             resp["degraded"] = True
             self._m_shap_degraded.inc()
+        if cache_key is not None and resp.get("shap_values") is not None:
+            self._score_cache_put(
+                cache_key,
+                (resp["prob_default"], resp["shap_values"], resp["base_value"]),
+                model=cache_model,
+            )
         return resp
 
     def predict_bulk_csv(
@@ -1192,7 +1487,16 @@ class ScorerService:
             raise ValidationError(f"csv missing feature columns: {missing}")
         X = df[model.feature_names].to_numpy(dtype=np.float32, na_value=np.nan)
         df = df.copy()
-        df["prob_default"] = model.predict_proba(X, deadline=dl)
+        # The snapshotted model scores (one request never mixes models), but
+        # the dispatch throughput still lands in the service's bulk counters.
+        with default_tracer().span(
+            "serve.bulk_score",
+            rows=int(X.shape[0]),
+            shards=model.bulk_part.n_shards,
+        ):
+            df["prob_default"] = model.predict_proba(
+                X, dl, self._observe_bulk_dispatch
+            )
         df = df.replace([np.inf, -np.inf], np.nan)
         records = df.to_dict(orient="records")
         for rec in records:

@@ -80,8 +80,8 @@ def test_store_uri_env_reaches_the_serve_cli(monkeypatch, tmp_path):
     class FakeService:
         feature_names = ["f0"]
 
-    def fake_from_store(store, cfg):
-        seen["store"] = store
+    def fake_from_store(store, cfg, **_kw):  # clock= rides along since the
+        seen["store"] = store  # ReplicaSet facade took over the CLI entry
         raise SystemExit  # stop before the HTTP server starts
 
     monkeypatch.setattr(m.ScorerService, "from_store", fake_from_store)

@@ -1,0 +1,228 @@
+"""Mesh-sharded bulk scoring: the `parallel.partitioner` abstraction and its
+service integration. The load-bearing assert is bit-exact parity — margins and
+SHAP from a forced multi-device ``dp`` mesh must equal the single-device
+program's output *bitwise* (`np.array_equal`, no tolerance), because the
+scoring contractions are per-row and both paths funnel through the one numpy
+sigmoid. Alongside parity: the padding protocol (N not divisible by the shard
+count, N smaller than the mesh), shard-count resolution, partition-rule
+matching, and the between-dispatch deadline checkpoint.
+
+conftest.py forces 8 virtual host devices (``xla_force_host_platform_device_
+count``), so the 4-way mesh here exists on any CI box."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from cobalt_smart_lender_ai_tpu.config import ServeConfig
+from cobalt_smart_lender_ai_tpu.io import GBDTArtifact
+from cobalt_smart_lender_ai_tpu.parallel.partitioner import (
+    DEFAULT_RULES,
+    MeshPartitioner,
+    SingleDevicePartitioner,
+    make_partitioner,
+    match_partition_rule,
+)
+from cobalt_smart_lender_ai_tpu.reliability import Deadline, DeadlineExceeded
+from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+SHARDS = 4
+
+
+def _cfg(**kw) -> ServeConfig:
+    """Bulk-only service: no micro-batcher, no bucket prewarm — this module
+    exercises the bulk partitioner path, not the single-row hot path."""
+    kw.setdefault("max_batch_rows", 64)  # small chunks: multi-chunk at N=1000
+    return ServeConfig(
+        microbatch_enabled=False,
+        precompile_batch_buckets=(),
+        prewarm_all_buckets=False,
+        score_cache_size=0,
+        **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def single_svc(serving_artifact):
+    store, _ = serving_artifact
+    return ScorerService.from_store(store, _cfg(bulk_shards=1))
+
+
+@pytest.fixture(scope="module")
+def mesh_svc(serving_artifact):
+    store, _ = serving_artifact
+    return ScorerService.from_store(store, _cfg(bulk_shards=SHARDS))
+
+
+# --- bit-exact parity ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 3, 4, 37, 256, 1000])
+def test_mesh_margins_bit_identical_to_single(single_svc, mesh_svc, serving_artifact, n):
+    """The headline guarantee: sharding the row axis over a 4-way dp mesh
+    changes WHERE rows score, never WHAT they score — probabilities are
+    bitwise equal for row counts below, at, and far above the mesh size,
+    divisible and not."""
+    _, X = serving_artifact
+    assert mesh_svc._model.bulk_part.n_shards == SHARDS
+    p1 = single_svc.predict_proba(X[:n])
+    p4 = mesh_svc.predict_proba(X[:n])
+    assert p1.shape == (n,)
+    assert np.array_equal(p1, p4), (
+        f"mesh/single divergence at n={n}: "
+        f"max |diff| {np.max(np.abs(p1 - p4))}"
+    )
+
+
+@pytest.mark.parametrize("n", [1, 5, 64])
+def test_mesh_shap_bit_identical_to_single(single_svc, mesh_svc, serving_artifact, n):
+    _, X = serving_artifact
+    phis1, base1 = single_svc.shap_bulk(X[:n])
+    phis4, base4 = mesh_svc.shap_bulk(X[:n])
+    assert phis1.shape == (n, single_svc._model.n_features)
+    assert np.array_equal(phis1, phis4)
+    assert base1 == base4
+
+
+def test_partitioner_level_parity(serving_artifact):
+    """Same assert one layer down, against the raw compiled programs — no
+    service chunking in the way. 8 rows over a 4-way mesh is exactly 2 rows
+    per shard."""
+    store, X = serving_artifact
+    art = GBDTArtifact.load(store, "models/gbdt/model_tree")
+    nf = len(art.feature_names)
+    X8 = np.ascontiguousarray(X[:8, :nf], dtype=np.float32)
+    single = SingleDevicePartitioner()
+    mesh = MeshPartitioner(jax.devices()[:SHARDS])
+    m1 = np.asarray(single.compile_margin(art.forest, nf, 8)(X8))
+    m4 = np.asarray(mesh.compile_margin(art.forest, nf, 8)(X8))
+    assert np.array_equal(m1, m4)
+    phis1, base1 = single.compile_shap(art.forest, nf, 8)(X8)
+    phis4, base4 = mesh.compile_shap(art.forest, nf, 8)(X8)
+    assert np.array_equal(np.asarray(phis1), np.asarray(phis4))
+    assert float(base1) == float(base4)
+
+
+# --- padding protocol ---------------------------------------------------------
+
+
+def test_chunker_pads_to_shard_multiple(mesh_svc, serving_artifact):
+    """N=37 does not divide 4: the chunker must hand the compiled program
+    ceil(37/4)=10 -> bucket 16 rows per shard = 64 padded rows, and report
+    n=37 so the caller slices the padding back off."""
+    _, X = serving_artifact
+    model = mesh_svc._model
+    chunks = list(model._bulk_chunks(np.asarray(X[:37], np.float32), None))
+    assert len(chunks) == 1
+    start, n, bucket, padded = chunks[0]
+    assert (start, n) == (0, 37)
+    assert bucket == 16  # power-of-two cover of the PER-SHARD row count
+    assert padded.shape[0] == bucket * SHARDS
+    assert np.all(padded[37:] == 0.0)  # tail is inert padding
+
+
+@pytest.mark.parametrize("n", [1, 2, 3])
+def test_fewer_rows_than_devices(mesh_svc, serving_artifact, n):
+    """N < mesh size still works: one row per shard (bucket 1), real rows in
+    the leading shards, padding in the rest."""
+    _, X = serving_artifact
+    model = mesh_svc._model
+    [(start, got_n, bucket, padded)] = model._bulk_chunks(
+        np.asarray(X[:n], np.float32), None
+    )
+    assert (start, got_n, bucket) == (0, n, 1)
+    assert padded.shape[0] == SHARDS
+    # and the scores for those rows are real, not padding artifacts
+    assert np.array_equal(
+        mesh_svc.predict_proba(X[:n]),
+        mesh_svc.predict_proba(X[:8])[:n],
+    )
+
+
+def test_mesh_rejects_undivisible_rows():
+    """The compile-time guard behind the padding contract: handing a mesh
+    program a row count that does not divide the shard count is a caller bug,
+    not something to mask."""
+    mesh = MeshPartitioner(jax.devices()[:SHARDS])
+    with pytest.raises(ValueError, match="pad to shard_multiple"):
+        mesh.compile_margin(None, 20, 10)
+    assert mesh.shard_multiple == SHARDS
+
+
+# --- deadline checkpoints between dispatches ----------------------------------
+
+
+class _ManualClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def test_deadline_checked_between_sharded_dispatches(mesh_svc, serving_artifact):
+    """The deadline is the cooperative cancellation point between mesh
+    dispatches: burn the budget during dispatch 2 of 3 (via the on_dispatch
+    hook) and the third chunk must 504 before launching, naming the row it
+    stopped at."""
+    _, X = serving_artifact
+    clk = _ManualClock()
+    dl = Deadline(1.0, clock=clk)
+    step = mesh_svc.config.max_batch_rows * SHARDS  # 256 rows per dispatch
+
+    def burn(rows, seconds):
+        clk.now += 0.6  # two dispatches overrun the 1.0s budget
+
+    with pytest.raises(DeadlineExceeded) as ei:
+        mesh_svc._model.predict_margin_bulk(
+            np.asarray(X[: step * 2 + 100], np.float32), dl, burn
+        )
+    assert f"bulk scoring, row {step * 2}/" in str(ei.value)
+
+
+# --- shard-count resolution and rules -----------------------------------------
+
+
+def test_make_partitioner_resolution():
+    n_dev = len(jax.devices())
+    assert isinstance(make_partitioner(0), SingleDevicePartitioner)
+    assert isinstance(make_partitioner(1), SingleDevicePartitioner)
+    every = make_partitioner(-1)
+    assert isinstance(every, MeshPartitioner)
+    assert every.n_shards == n_dev
+    assert make_partitioner(3).n_shards == 3
+    # over-asking clamps to the host, never crashes
+    assert make_partitioner(10 * n_dev).n_shards == n_dev
+
+
+def test_match_partition_rule():
+    assert match_partition_rule(DEFAULT_RULES, "rows", "dp") == P("dp", None)
+    assert match_partition_rule(DEFAULT_RULES, "X", "dp") == P("dp", None)
+    assert match_partition_rule(DEFAULT_RULES, "forest", "dp") == P()
+    with pytest.raises(ValueError, match="no partition rule"):
+        match_partition_rule((), "rows", "dp")
+
+
+def test_describe_shapes(mesh_svc, single_svc):
+    d4 = mesh_svc._model.bulk_part.describe()
+    assert d4["shards"] == SHARDS
+    assert d4["mesh"] == {"dp": SHARDS}
+    assert len(d4["devices"]) == SHARDS
+    d1 = single_svc._model.bulk_part.describe()
+    assert d1 == {"shards": 1, "mesh": None, "devices": None}
+
+
+def test_readyz_reports_mesh_shape(mesh_svc, serving_artifact):
+    """/readyz carries the bulk block the CI bulk-smoke job asserts on:
+    mesh shape plus the sharded buckets compiled so far."""
+    _, X = serving_artifact
+    mesh_svc.predict_proba(X[:8])  # ensure at least one compiled bucket
+    ok, payload = mesh_svc.ready()
+    assert ok
+    bulk = payload["bulk"]
+    assert bulk["shards"] == SHARDS
+    assert bulk["mesh"] == {"dp": SHARDS}
+    assert bulk["compiled_buckets"], "no sharded bucket recorded after a dispatch"

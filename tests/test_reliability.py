@@ -510,12 +510,17 @@ def test_pipeline_completes_under_injected_faults(tmp_path, small_raw):
 def degraded_service(serving_artifact, monkeypatch):
     """ScorerService whose SHAP program fails to build (forced), configured
     to degrade rather than die."""
+    import cobalt_smart_lender_ai_tpu.parallel.partitioner as partitioner_mod
     import cobalt_smart_lender_ai_tpu.serve.service as service_mod
 
     def broken_shap(*a, **k):
         raise RuntimeError("SHAP compile forced to fail")
 
-    monkeypatch.setattr(service_mod, "shap_values", broken_shap)
+    # The SHAP program is compiled by the partitioner (not the service), and
+    # structure-identical forests share cached executables — swap in an empty
+    # cache so the forced compile failure actually fires.
+    monkeypatch.setattr(partitioner_mod, "shap_values", broken_shap)
+    monkeypatch.setattr(partitioner_mod, "_EXEC_CACHE", {})
     store, _ = serving_artifact
     return service_mod.ScorerService.from_store(store, _fast_cfg())
 
@@ -575,13 +580,15 @@ def test_runtime_shap_failure_degrades(serving_artifact):
 
 def test_degrade_disabled_raises(serving_artifact, monkeypatch):
     """degrade_shap=False keeps the old fail-fast behavior."""
+    import cobalt_smart_lender_ai_tpu.parallel.partitioner as partitioner_mod
     import cobalt_smart_lender_ai_tpu.serve.service as service_mod
     from cobalt_smart_lender_ai_tpu.config import ServeConfig
 
     def broken_shap(*a, **k):
         raise RuntimeError("SHAP compile forced to fail")
 
-    monkeypatch.setattr(service_mod, "shap_values", broken_shap)
+    monkeypatch.setattr(partitioner_mod, "shap_values", broken_shap)
+    monkeypatch.setattr(partitioner_mod, "_EXEC_CACHE", {})
     store, _ = serving_artifact
     cfg = ServeConfig(
         reliability=ReliabilityConfig(degrade_shap=False)

@@ -1,0 +1,278 @@
+"""Multi-replica serving engine: least-loaded routing (including drain-around
+of a stalled replica), fleet shape in ``/readyz``, per-replica metric
+families, and the atomic all-replica hot reload — one replica's candidate
+failing must roll the WHOLE fleet back, even the replicas whose candidates
+built fine."""
+
+import dataclasses
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cobalt_smart_lender_ai_tpu.config import ServeConfig
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+from cobalt_smart_lender_ai_tpu.serve.replicas import (
+    ReplicaSet,
+    resolve_replica_devices,
+)
+from cobalt_smart_lender_ai_tpu.serve.service import (
+    SINGLE_INPUT_FIELDS,
+    ScorerService,
+)
+
+N_REPLICAS = 3
+
+
+def _cfg(**kw) -> ServeConfig:
+    kw.setdefault("replicas", N_REPLICAS)
+    return ServeConfig(
+        microbatch_enabled=False,
+        precompile_batch_buckets=(),
+        prewarm_all_buckets=False,
+        score_cache_size=0,  # routing tests count real dispatches
+        **kw,
+    )
+
+
+def _payload() -> dict:
+    return {
+        canonical: 1 if canonical in schema.SERVING_INT_FEATURES else 1.5
+        for canonical in SINGLE_INPUT_FIELDS.values()
+    }
+
+
+def _routed_counts(fleet: ReplicaSet) -> list[int]:
+    return [
+        int(fleet._m_routed.labels(replica=str(i)).value)
+        for i in range(len(fleet.replicas))
+    ]
+
+
+@pytest.fixture(scope="module")
+def fleet(serving_artifact):
+    store, _ = serving_artifact
+    f = ReplicaSet.from_store(store, _cfg())
+    yield f
+    f.close()
+
+
+# --- construction -------------------------------------------------------------
+
+
+def test_from_store_single_replica_is_plain_service(serving_artifact):
+    """replicas<=1 must NOT wrap: the facade adds nothing when there is
+    nothing to route between, and the adapters get the exact object the
+    pre-replica deployments ran."""
+    store, _ = serving_artifact
+    svc = ReplicaSet.from_store(store, _cfg(replicas=1))
+    assert isinstance(svc, ScorerService)
+    svc.close()
+
+
+def test_resolve_replica_devices():
+    n_dev = len(jax.devices())  # conftest forces 8
+    assert resolve_replica_devices(4, False) == [None] * 4
+    pinned = resolve_replica_devices(n_dev + 2, True)
+    assert len(pinned) == n_dev + 2
+    assert len({str(d) for d in pinned[:n_dev]}) == n_dev  # distinct first lap
+    assert str(pinned[n_dev]) == str(pinned[0])  # then round-robin wraps
+
+
+def test_fleet_shape_in_readyz(fleet):
+    ok, payload = fleet.ready()
+    assert ok and payload["status"] == "ok"
+    assert payload["replicas"] == N_REPLICAS
+    assert len(payload["replica_devices"]) == N_REPLICAS
+    # 8 forced devices > 3 replicas: every replica pinned to its own device
+    assert len(set(payload["replica_devices"])) == N_REPLICAS
+    assert payload["router"]["policy"] == "least_loaded"
+    assert payload["router"]["in_flight"] == [0] * N_REPLICAS
+    assert len(payload["per_replica"]) == N_REPLICAS
+    assert payload["bulk"]["shards"] == 1  # replicas scale out, not the mesh
+
+
+# --- routing ------------------------------------------------------------------
+
+
+def test_idle_fleet_round_robins(fleet):
+    """Tie-breaking: an idle fleet (all loads 0) must rotate, not hotspot
+    replica 0 — warm caches everywhere."""
+    before = _routed_counts(fleet)
+    for _ in range(2 * N_REPLICAS):
+        resp = fleet.predict_single(_payload())
+        assert 0.0 <= resp["prob_default"] <= 1.0
+    after = _routed_counts(fleet)
+    assert [a - b for a, b in zip(after, before)] == [2] * N_REPLICAS
+
+
+def test_router_avoids_loaded_replica(fleet):
+    """The load signal steers: with replica 1 carrying synthetic in-flight
+    load, no pick lands on it until the load drains."""
+    picks: list[int] = []
+    with fleet._route_lock:
+        fleet._inflight[1] += 5
+    try:
+        picks = [fleet._pick() for _ in range(2 * N_REPLICAS)]
+        assert 1 not in picks
+    finally:
+        with fleet._route_lock:
+            fleet._inflight[1] -= 5
+            for i in picks:
+                fleet._inflight[i] -= 1  # release the synthetic picks
+
+
+def test_stalled_replica_drained_around(fleet):
+    """The ISSUE's router scenario end-to-end: one replica wedges mid-request
+    (its in-flight count stays up), and every subsequent request completes on
+    the healthy replicas without queueing behind the stall."""
+    release = threading.Event()
+    stalled = threading.Event()
+    claim_lock = threading.Lock()
+    claimed: list[int] = []
+    originals = [rep.predict_single for rep in fleet.replicas]
+
+    def _wrap(i, orig):
+        def wrapped(payload, *, deadline=None):
+            with claim_lock:
+                first = not claimed
+                if first:
+                    claimed.append(i)
+            if first:  # only the first-routed request wedges
+                stalled.set()
+                release.wait(timeout=10)
+            return orig(payload, deadline=deadline)
+
+        return wrapped
+
+    for i, rep in enumerate(fleet.replicas):
+        rep.predict_single = _wrap(i, originals[i])
+    try:
+        t = threading.Thread(
+            target=fleet.predict_single, args=(_payload(),), daemon=True
+        )
+        t.start()
+        assert stalled.wait(timeout=10), "no request reached a replica"
+        victim = claimed[0]
+        before = _routed_counts(fleet)
+        for _ in range(2 * N_REPLICAS):
+            resp = fleet.predict_single(_payload())  # returns promptly
+            assert "prob_default" in resp
+        after = _routed_counts(fleet)
+        assert after[victim] == before[victim], (
+            "router sent traffic to the stalled replica"
+        )
+        assert sum(after) - sum(before) == 2 * N_REPLICAS
+    finally:
+        release.set()
+        t.join(timeout=10)
+        for rep, orig in zip(fleet.replicas, originals):
+            rep.predict_single = orig
+    assert not t.is_alive()
+
+
+# --- per-replica metrics ------------------------------------------------------
+
+
+def test_replica_metric_families_in_exposition(fleet):
+    fleet.predict_single(_payload())
+    text = fleet.registry.render()
+    for family in (
+        "cobalt_replica_count",
+        "cobalt_replica_in_flight",
+        "cobalt_replica_routed_total",
+        "cobalt_replica_queue_depth",
+        "cobalt_request_latency_seconds",
+    ):
+        assert family in text, f"{family} missing from fleet /metrics"
+    assert 'replica="2"' in text  # labeled per replica, not aggregated
+
+
+def test_observe_request_feeds_fleet_registry(fleet):
+    fleet.observe_request("predict", 504, 0.25, code="deadline_exceeded")
+    text = fleet.registry.render()
+    assert "cobalt_request_errors_total" in text
+    assert 'code="deadline_exceeded"' in text
+
+
+# --- atomic fleet reload ------------------------------------------------------
+
+
+def _zeroed(art: GBDTArtifact) -> GBDTArtifact:
+    """Every leaf 0 -> margin 0 -> P(default) exactly 0.5: a fleet-wide swap
+    to it is observable from one prediction per replica."""
+    return dataclasses.replace(
+        art,
+        forest=dataclasses.replace(
+            art.forest, leaf_value=jnp.zeros_like(art.forest.leaf_value)
+        ),
+    )
+
+
+@pytest.fixture()
+def private_fleet(tmp_path, serving_artifact):
+    """2-replica fleet on a private store copy — reload tests write new model
+    versions, which must not leak into the shared session store."""
+    shared, X = serving_artifact
+    art = GBDTArtifact.load(shared, "models/gbdt/model_tree")
+    store = ObjectStore(str(tmp_path / "lake"))
+    art.save(store, "models/gbdt/model_tree")
+    f = ReplicaSet.from_store(store, _cfg(replicas=2))
+    yield f, store, art
+    f.close()
+
+
+def test_fleet_reload_publishes_everywhere(private_fleet):
+    fleet, store, art = private_fleet
+    _zeroed(art).save(store, "models/gbdt/model_tree")
+    result = fleet.reload_from_store()
+    assert result["status"] == "ok"
+    assert result["replicas"] == 2
+    # EVERY replica serves the new model — probe each directly, not routed
+    for rep in fleet.replicas:
+        assert rep.predict_single(_payload())["prob_default"] == 0.5
+    ok, payload = fleet.ready()
+    assert ok and payload["last_reload"]["status"] == "ok"
+
+
+def test_fleet_reload_is_all_or_nothing(private_fleet):
+    """Atomicity, the hard half: replica 0's candidate builds FINE, replica
+    1's fails — and replica 0 must still be serving the OLD model afterwards
+    (its good candidate was never published)."""
+    fleet, store, art = private_fleet
+    baseline = [
+        rep.predict_single(_payload())["prob_default"] for rep in fleet.replicas
+    ]
+    _zeroed(art).save(store, "models/gbdt/model_tree")
+
+    def _boom(store, key):
+        raise RuntimeError("injected candidate failure")
+
+    fleet.replicas[1]._build_candidate = _boom
+    result = fleet.reload_from_store()
+    assert result["status"] == "rolled_back"
+    assert "injected candidate failure" in result["error"]
+    for rep, prob in zip(fleet.replicas, baseline):
+        assert rep.predict_single(_payload())["prob_default"] == prob, (
+            "a replica published a candidate despite the fleet rollback"
+        )
+    _, payload = fleet.ready()
+    assert payload["last_reload"]["status"] == "rolled_back"
+
+
+def test_fleet_reload_bad_artifact_rolls_back(private_fleet):
+    """A genuinely bad artifact (feature names the schema can't serve) fails
+    every candidate's smoke check and the fleet keeps serving."""
+    fleet, store, art = private_fleet
+    renamed = dataclasses.replace(
+        art, feature_names=tuple(f"x_{i}" for i in range(len(art.feature_names)))
+    )
+    renamed.save(store, "models/gbdt/renamed")
+    result = fleet.reload_from_store(model_key="models/gbdt/renamed")
+    assert result["status"] == "rolled_back"
+    assert fleet.predict_single(_payload())["prob_default"] == pytest.approx(
+        fleet.replicas[0].predict_single(_payload())["prob_default"]
+    )

@@ -1,0 +1,113 @@
+"""Content-hash score cache for repeated single-row payloads: the cache key
+is the canonicalized (1, F) float32 vector's raw bytes, so two payloads that
+validate to the same features hit the same entry whatever their key order or
+alias spelling. Covered here: hit/miss counters (surfaced in ``/readyz`` from
+the same ``cobalt_score_cache_*`` cells ``/metrics`` serves), LRU eviction at
+the size bound, invalidation on hot reload (entries fingerprint the model
+that is leaving), and the size-0 kill switch."""
+
+import pytest
+
+from cobalt_smart_lender_ai_tpu.config import ServeConfig
+from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+
+def _cfg(**kw) -> ServeConfig:
+    return ServeConfig(
+        microbatch_enabled=False,  # direct path: each miss is one dispatch
+        precompile_batch_buckets=(),
+        prewarm_all_buckets=False,
+        **kw,
+    )
+
+
+def _payload(loan_amnt: float = 9.2, aliased: bool = True) -> dict:
+    vals = {
+        "loan_amnt": loan_amnt, "term": 36.0, "installment": 5.7,
+        "fico_range_low": 6.55, "last_fico_range_high": 690.0,
+        "open_il_12m": 1.0, "open_il_24m": 2.0, "max_bal_bc": 5000.0,
+        "num_rev_accts": 2.3, "pub_rec_bankruptcies": 0.0,
+        "emp_length_num": 5.0, "earliest_cr_line_days": 8.6,
+        "grade_E": 0, "home_ownership_MORTGAGE": 1,
+        "verification_status_Verified": 0,
+        "application_type_Joint App": 0,
+        "hardship_status_BROKEN": 0, "hardship_status_COMPLETE": 0,
+        "hardship_status_COMPLETED": 0, "hardship_status_No Hardship": 1,
+    }
+    if not aliased:
+        vals["application_type_Joint_App"] = vals.pop("application_type_Joint App")
+        vals["hardship_status_No_Hardship"] = vals.pop("hardship_status_No Hardship")
+    return vals
+
+
+def _cache_stats(svc: ScorerService) -> dict:
+    return svc.ready()[1]["score_cache"]
+
+
+def test_repeat_payload_hits_and_matches(serving_artifact):
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store, _cfg())
+    first = svc.predict_single(_payload())
+    second = svc.predict_single(_payload())
+    assert second == first  # a hit returns the full response, bit for bit
+    stats = _cache_stats(svc)
+    assert (stats["hits"], stats["misses"], stats["entries"]) == (1, 1, 1)
+    svc.close()
+
+
+def test_alias_spellings_share_one_entry(serving_artifact):
+    """The two aliased field names canonicalize before hashing: the aliased
+    and underscored spellings of the same application are ONE cached score."""
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store, _cfg())
+    svc.predict_single(_payload(aliased=True))
+    resp = svc.predict_single(_payload(aliased=False))
+    stats = _cache_stats(svc)
+    assert (stats["hits"], stats["misses"], stats["entries"]) == (1, 1, 1)
+    assert resp["shap_values"] is not None
+    svc.close()
+
+
+def test_lru_eviction_at_size_bound(serving_artifact):
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store, _cfg(score_cache_size=2))
+    for amt in (1.0, 2.0, 3.0):  # third insert evicts the LRU entry (1.0)
+        svc.predict_single(_payload(loan_amnt=amt))
+    assert _cache_stats(svc)["entries"] == 2
+    svc.predict_single(_payload(loan_amnt=1.0))
+    stats = _cache_stats(svc)
+    assert stats["misses"] == 4 and stats["hits"] == 0  # 1.0 was evicted
+    svc.predict_single(_payload(loan_amnt=3.0))
+    assert _cache_stats(svc)["hits"] == 1  # 3.0 survived both evictions
+    svc.close()
+
+
+def test_reload_invalidates_cache(tmp_path, serving_artifact):
+    """Cached scores fingerprint the model that produced them: a hot swap —
+    even to a model scoring identically — must empty the cache, or stale
+    probabilities would outlive the artifact they came from."""
+    shared, _ = serving_artifact
+    art = GBDTArtifact.load(shared, "models/gbdt/model_tree")
+    store = ObjectStore(str(tmp_path / "lake"))
+    art.save(store, "models/gbdt/model_tree")
+    svc = ScorerService.from_store(store, _cfg())
+    svc.predict_single(_payload())
+    svc.predict_single(_payload())
+    assert _cache_stats(svc)["entries"] == 1
+    assert svc.reload_from_store()["status"] == "ok"
+    assert _cache_stats(svc)["entries"] == 0
+    svc.predict_single(_payload())
+    stats = _cache_stats(svc)
+    assert stats["misses"] == 2 and stats["entries"] == 1
+    svc.close()
+
+
+def test_size_zero_disables(serving_artifact):
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store, _cfg(score_cache_size=0))
+    svc.predict_single(_payload())
+    svc.predict_single(_payload())
+    stats = _cache_stats(svc)
+    assert stats == {"size": 0, "entries": 0, "hits": 0, "misses": 0}
+    svc.close()
