@@ -339,6 +339,47 @@ class ServeConfig:
     slo_availability_target: float = 0.999
     slo_windows_s: tuple[float, ...] = (60.0, 3600.0)
     slo_fast_burn_threshold: float = 14.4
+    #: Continuous-training loop (io.model_registry + serve.canary, README
+    #: "Continuous training"). Opt-in: a store without a model registry has
+    #: nothing to canary, and existing single-artifact deployments keep
+    #: byte-identical behavior. When enabled, `from_store` resolves the
+    #: registry's ``latest`` channel for ``model_name``, loads any published
+    #: ``canary`` beside the champion, and shadow-scores a slice of live
+    #: single-row traffic through it (the canary's result is NEVER returned
+    #: to the caller).
+    canary_enabled: bool = False
+    model_name: str = "gbdt"
+    registry_prefix: str = "registry"
+    #: Fraction of validated single-row requests shadow-scored through the
+    #: canary (deterministic stride sampling, no RNG on the request path).
+    canary_sample_rate: float = 1.0
+    #: Shadow-comparison window: the gate evaluates over the most recent
+    #: ``canary_window`` sampled requests, and needs at least
+    #: ``canary_min_samples`` of them before promotion is even considered.
+    canary_window: int = 2048
+    canary_min_samples: int = 50
+    #: Promotion gate thresholds. The AUC proxy is the rank correlation of
+    #: canary vs champion scores over the window (labels don't exist at
+    #: serve time; the champion's ranking is the pseudo-ground-truth — a
+    #: label-shuffled candidate scores ~0). Latency is compared as the ratio
+    #: of mean shadow-dispatch time to mean champion dispatch time; errors
+    #: as canary scoring failures over sampled requests.
+    canary_min_score_corr: float = 0.5
+    canary_max_score_delta: float = 0.25
+    canary_max_latency_ratio: float = 5.0
+    canary_max_error_ratio: float = 0.05
+    #: Post-promotion guard window: if the SLO engine reports fast burn
+    #: (telemetry.slo) within this many seconds of a promotion, ``latest``
+    #: is automatically demoted back to ``previous`` fleet-wide.
+    promotion_guard_window_s: float = 300.0
+    #: Drift detection (telemetry.drift, ``GET /drift``): PSI per feature of
+    #: the live shadow-tap sketch vs the training snapshot shipped in the
+    #: registry provenance; over ``drift_psi_alert`` on any feature raises
+    #: the drift alarm (and fires the controller's ``on_drift`` hook, which
+    #: can trigger `tools/retrain.py`).
+    drift_bins: int = 10
+    drift_psi_alert: float = 0.25
+    drift_min_samples: int = 100
     reliability: ReliabilityConfig = dataclasses.field(
         default_factory=ReliabilityConfig
     )

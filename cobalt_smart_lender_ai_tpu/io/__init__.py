@@ -13,6 +13,11 @@ from cobalt_smart_lender_ai_tpu.io.artifacts import (
     plan_to_json,
     save_metrics,
 )
+from cobalt_smart_lender_ai_tpu.io.model_registry import (
+    CHANNELS,
+    ModelRegistry,
+    ModelVersion,
+)
 from cobalt_smart_lender_ai_tpu.io.registry import (
     REFERENCE_RAW_PINS,
     DatasetPin,
@@ -25,11 +30,14 @@ from cobalt_smart_lender_ai_tpu.io.store import (
 )
 
 __all__ = [
+    "CHANNELS",
     "FORMAT_VERSION",
     "DatasetPin",
     "DatasetRegistry",
     "GBDTArtifact",
     "MLPArtifact",
+    "ModelRegistry",
+    "ModelVersion",
     "ObjectStore",
     "PTR_SUFFIX",
     "StoreKeyError",

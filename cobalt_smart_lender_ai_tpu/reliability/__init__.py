@@ -59,9 +59,11 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
     CircuitOpenError,
     DeadlineExceeded,
     PayloadTooLarge,
+    PromotionRejected,
     ReloadFailed,
     RequestError,
     RequestShed,
+    RollbackFailed,
     ValidationError,
     error_response,
 )
@@ -93,11 +95,13 @@ __all__ = [
     "InjectedFault",
     "PayloadTooLarge",
     "PipelineCheckpoint",
+    "PromotionRejected",
     "ReloadFailed",
     "RequestError",
     "RequestShed",
     "ResilientStore",
     "RetryPolicy",
+    "RollbackFailed",
     "TokenBucket",
     "ValidationError",
     "admission_from_config",

@@ -113,6 +113,32 @@ class ReloadFailed(RequestError):
     code = "reload_failed"
 
 
+class PromotionRejected(RequestError):
+    """The canary promotion gate said no (or there is no canary to promote)
+    — HTTP 409. Carries the gate's structured ``report`` (sample counts,
+    per-check verdicts, machine-readable reasons) in the body so the retrain
+    driver and operators see *why* without parsing prose."""
+
+    status = 409
+    code = "promotion_rejected"
+
+    def __init__(self, detail: str = "", *, report: dict | None = None):
+        super().__init__(detail)
+        self.report = report or {}
+
+    def body(self) -> dict:
+        return {**super().body(), "report": self.report}
+
+
+class RollbackFailed(RequestError):
+    """A rollback was requested but there is no ``previous`` channel to
+    restore (or the registry is unavailable) — HTTP 409, not a 500: the
+    serving model is untouched and still healthy."""
+
+    status = 409
+    code = "rollback_failed"
+
+
 def error_response(exc: RequestError) -> tuple[int, dict, dict[str, str]]:
     """The single adapter-side mapping: (HTTP status, JSON body, headers)."""
     return exc.status, exc.body(), exc.headers()

@@ -88,6 +88,24 @@ def main() -> None:
         "flight recorder (GET /debug/slowest names the slow phase)",
     )
     parser.add_argument(
+        "--canary",
+        action="store_true",
+        help="enable the continuous-training loop: serve the model "
+        "registry's 'latest' channel, shadow-score any published canary, "
+        "expose /admin/promote, /admin/rollback and /drift",
+    )
+    parser.add_argument(
+        "--model-name",
+        default=ServeConfig.model_name,
+        help="registry model name whose channels the canary loop follows",
+    )
+    parser.add_argument(
+        "--canary-sample-rate",
+        type=float,
+        default=ServeConfig.canary_sample_rate,
+        help="fraction of scoring traffic shadow-scored against the canary",
+    )
+    parser.add_argument(
         "--profile-dir",
         default=None,
         help="capture a jax.profiler trace of the whole serving session "
@@ -117,6 +135,9 @@ def main() -> None:
         replica_devices=not args.no_replica_devices,
         bulk_shards=args.bulk_shards,
         score_cache_size=args.score_cache_size,
+        canary_enabled=args.canary,
+        model_name=args.model_name,
+        canary_sample_rate=args.canary_sample_rate,
     )
     # ReplicaSet.from_store returns a plain ScorerService at replicas<=1;
     # both present the identical adapter surface.
@@ -133,6 +154,12 @@ def main() -> None:
     if cfg.bulk_shards not in (0, 1):
         print(f"[INFO] bulk scoring sharded over the dp mesh "
               f"(bulk_shards={cfg.bulk_shards})")
+    if cfg.canary_enabled:
+        info = service.model_info
+        print(f"[INFO] continuous training on: serving "
+              f"{cfg.model_name}/{info['version']} ({info['channel']}); "
+              f"canary shadow rate {cfg.canary_sample_rate:g}; "
+              "POST /admin/promote, /admin/rollback; GET /drift")
     if cfg.microbatch_enabled:
         print(f"[INFO] micro-batching on: wait {cfg.microbatch_max_wait_ms}ms, "
               f"max {cfg.microbatch_max_rows} rows/dispatch"

@@ -7,8 +7,10 @@ including the two aliased field names with spaces and
 population-by-field-name. Error mapping is shared with the stdlib adapter
 through `reliability.errors.error_response`, so both adapters emit the same
 taxonomy (422/413/429/503/504 with ``Retry-After`` where applicable), and
-both expose the same ``POST /admin/reload`` hot-swap endpoint and
-``GET /metrics`` Prometheus exposition.
+both expose the same admin plane (``POST /admin/reload`` hot swap,
+``POST /admin/promote`` / ``POST /admin/rollback`` for the continuous-
+training loop), ``GET /drift`` PSI report, and ``GET /metrics`` Prometheus
+exposition.
 
 Telemetry (mirrored in `http_stdlib.py`): each route body runs inside
 `_track(route, ...)` — a per-request envelope that binds the request-id
@@ -107,6 +109,12 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
 
     class ReloadInput(BaseModel):
         model_key: Optional[str] = None
+
+    class PromoteInput(BaseModel):
+        force: bool = False
+
+    class RollbackInput(BaseModel):
+        reason: str = "manual"
 
     state: dict[str, ScorerService] = {}
     if service is not None:
@@ -265,6 +273,44 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
                 exc.cobalt_code = "reload_failed"
                 raise exc
             return result
+
+    @app.post("/admin/promote")
+    def admin_promote(
+        data: PromoteInput = None, request: Request = None, response: Response = None
+    ):
+        # Admin plane, same as /admin/reload. A gate rejection keeps its
+        # structured report: the 409 detail IS the typed body (code+report).
+        with _track("/admin/promote", request, response):
+            from cobalt_smart_lender_ai_tpu.reliability.errors import (
+                PromotionRejected,
+            )
+
+            try:
+                return state["service"].promote_canary(
+                    force=bool(data.force) if data is not None else False
+                )
+            except PromotionRejected as e:
+                exc = HTTPException(status_code=e.status, detail=e.body())
+                exc.cobalt_code = e.code
+                raise exc
+            except RequestError as e:
+                _raise_typed(e)
+
+    @app.post("/admin/rollback")
+    def admin_rollback(
+        data: RollbackInput = None, request: Request = None, response: Response = None
+    ):
+        with _track("/admin/rollback", request, response):
+            try:
+                return state["service"].rollback_model(
+                    reason=data.reason if data is not None else "manual"
+                )
+            except RequestError as e:
+                _raise_typed(e)
+
+    @app.get("/drift")
+    def drift():
+        return state["service"].drift_report()
 
     @app.get("/healthz")
     def healthz():

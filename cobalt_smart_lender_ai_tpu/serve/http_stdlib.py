@@ -12,6 +12,13 @@ match the reference:
 - ``POST /predict_bulk_csv``      — multipart file upload or raw CSV body
 - ``POST /feature_importance_bulk`` — JSON ``{"data": [...]}``, 400 if empty
 - ``POST /admin/reload``          — hot model swap (optional ``model_key``)
+- ``POST /admin/promote``         — canary promotion gate + atomic swap
+  (409 ``promotion_rejected`` with the gate report when the canary fails;
+  ``{"force": true}`` bypasses the gate)
+- ``POST /admin/rollback``        — demote ``latest`` back to ``previous``
+  (409 ``rollback_failed`` when there is nothing to restore)
+- ``GET /drift``                  — per-feature PSI of live traffic vs the
+  training snapshot (serve.canary / telemetry.drift)
 - ``GET /metrics``                — Prometheus text exposition of
   ``service.registry`` (README "Observability"); with ``Accept:
   application/openmetrics-text`` the latency buckets carry exemplar
@@ -74,10 +81,13 @@ _KNOWN_ROUTES = frozenset(
         "/predict_bulk_csv",
         "/feature_importance_bulk",
         "/admin/reload",
+        "/admin/promote",
+        "/admin/rollback",
         "/healthz",
         "/readyz",
         "/metrics",
         "/slo",
+        "/drift",
         "/debug/requests",
         "/debug/slowest",
         "/debug/trace",
@@ -243,6 +253,24 @@ def make_handler(service: ScorerService):
                 # data plane is shedding.
                 self._admin_reload(body)
                 return
+            if self._route_path == "/admin/promote":
+                # Same admin plane; `PromotionRejected` (409 + structured
+                # gate report) propagates through the typed-error mapping.
+                payload = self._json_body(body)
+                force = isinstance(payload, dict) and bool(
+                    payload.get("force", False)
+                )
+                self._send(200, service.promote_canary(force=force))
+                return
+            if self._route_path == "/admin/rollback":
+                payload = self._json_body(body)
+                reason = (
+                    str(payload.get("reason", "manual"))
+                    if isinstance(payload, dict)
+                    else "manual"
+                )
+                self._send(200, service.rollback_model(reason=reason))
+                return
             if self._route_path == "/predict":
                 with service.admission.admit():
                     self._send(
@@ -339,6 +367,8 @@ def make_handler(service: ScorerService):
                     )
                 else:
                     self._send(200, service.slo.evaluate(force=True))
+            elif path == "/drift":
+                self._send(200, service.drift_report())
             elif path == "/debug/requests":
                 n = self._query_int("n", 50)
                 self._send(

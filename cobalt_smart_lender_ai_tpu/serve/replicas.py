@@ -114,6 +114,12 @@ class ReplicaSet:
         self.slo: SLOEngine | None = None
         self._swap_lock = threading.Lock()
         self._last_reload: dict | None = None
+        # Continuous-training loop: ONE facade-level controller (populated by
+        # `enable_canary`) shadow-scores for the whole fleet — promotion and
+        # rollback go through the facade's atomic all-or-nothing
+        # `reload_from_store`, so the fleet never serves mixed versions.
+        self.canary = None
+        self._model_identity: dict | None = None
         self._init_metrics()
         if config.slo_enabled:
             self.slo = SLOEngine(
@@ -142,21 +148,28 @@ class ReplicaSet:
         if n == 1:
             return ScorerService.from_store(store, cfg, clock=clock)
         devices = resolve_replica_devices(n, cfg.replica_devices)
+        # enable_canary=False: the replica still resolves the registry's
+        # ``latest`` channel for its model key, but the shadow-scoring
+        # controller attaches to the FACADE below — a per-replica controller
+        # could promote one replica and leave the rest on the old model.
         first = ScorerService.from_store(
-            store, cfg, clock=clock, device=devices[0]
+            store, cfg, clock=clock, device=devices[0], enable_canary=False
         )
         replicas = [first]
         for i in range(1, n):
-            replicas.append(
-                ScorerService(
-                    first.artifact,
-                    cfg,
-                    store=store,
-                    clock=clock,
-                    device=devices[i],
-                )
+            rep = ScorerService(
+                first.artifact,
+                cfg,
+                store=store,
+                clock=clock,
+                device=devices[i],
             )
-        return cls(replicas, cfg, clock=clock)
+            rep._model_key = first._model_key
+            replicas.append(rep)
+        fleet = cls(replicas, cfg, clock=clock)
+        if cfg.canary_enabled:
+            fleet.enable_canary()
+        return fleet
 
     # -- metrics ---------------------------------------------------------------
 
@@ -222,6 +235,17 @@ class ReplicaSet:
             "fleet-wide hot swap attempts by outcome (ok / rolled_back)",
             ("status",),
         )
+        # Fleet model identity: one series at 1.0 for the version every
+        # replica serves (the fleet swap is all-or-nothing, so there is
+        # exactly one), moved by `set_model_info` on promote/rollback.
+        self._m_model_info = reg.gauge(
+            "cobalt_model_info",
+            "identity of the serving model (value is always 1; the labels "
+            "carry the information)",
+            ("version", "channel", "provenance_md5"),
+        )
+        self._model_info_labels = ("unversioned", "direct", "none")
+        self._m_model_info.labels(*self._model_info_labels).set(1.0)
         for i, rep in enumerate(self.replicas):
             g_inflight.labels(replica=str(i)).set_function(
                 lambda i=i: self._inflight[i]
@@ -271,7 +295,15 @@ class ReplicaSet:
         self, payload: Mapping[str, Any], *, deadline=None
     ) -> dict:
         with self._routed() as rep:
-            return rep.predict_single(payload, deadline=deadline)
+            resp = rep.predict_single(payload, deadline=deadline)
+        # The replicas serve anonymously (their `_model_identity` stays
+        # None); the fleet's identity and shadow tap live on the facade.
+        if self._model_identity is not None:
+            resp["model_version"] = self._model_identity["version"]
+        can = self.canary
+        if can is not None:
+            can.tap(resp["input_row"], resp["prob_default"], None)
+        return resp
 
     def predict_bulk_csv(self, csv_bytes: bytes, *, deadline=None) -> dict:
         with self._routed() as rep:
@@ -307,6 +339,8 @@ class ReplicaSet:
         )
         if status >= 400:
             self._m_errors.labels(route=route, code=code or "error").inc()
+        if self.canary is not None:
+            self.canary.maybe_auto_rollback()
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -356,6 +390,10 @@ class ReplicaSet:
         }
         if self._last_reload is not None:
             payload["last_reload"] = self._last_reload
+        payload["model"] = self.model_info
+        if self.canary is not None:
+            self.canary.maybe_auto_rollback()
+            payload["canary"] = self.canary.status()
         return all_ready, payload
 
     def reload_from_store(
@@ -408,6 +446,108 @@ class ReplicaSet:
             _LOG.info("fleet_reload", **self._last_reload)
             return self._last_reload
 
+    # -- continuous-training loop (serve.canary) -------------------------------
+
+    @property
+    def _model_key(self) -> str | None:
+        """The key every replica serves (fleet swaps are all-or-nothing)."""
+        return self.replicas[0]._model_key
+
+    @property
+    def _store(self):
+        return self.replicas[0]._store
+
+    @property
+    def model_info(self) -> dict:
+        """Identity of the fleet's serving model — `/readyz`'s ``model``
+        block and the ``model_version`` field of scoring responses."""
+        if self._model_identity is not None:
+            return self._model_identity
+        return {
+            "version": "unversioned",
+            "channel": "direct",
+            "provenance_md5": None,
+        }
+
+    def set_model_info(
+        self, *, version: str, channel: str, provenance_md5: str | None
+    ) -> None:
+        """Move the `cobalt_model_info` gauge to a new identity (the old
+        label combination drops to 0 so joins never see two live models)."""
+        self._model_identity = {
+            "version": version,
+            "channel": channel,
+            "provenance_md5": provenance_md5,
+        }
+        new_labels = (version, channel, provenance_md5 or "none")
+        self._m_model_info.labels(*self._model_info_labels).set(0.0)
+        self._m_model_info.labels(*new_labels).set(1.0)
+        self._model_info_labels = new_labels
+
+    def enable_canary(self, on_drift=None) -> "ReplicaSet":
+        """Attach ONE fleet-level continuous-training controller (idempotent).
+
+        The controller shadow-scores against the facade's routed responses
+        and swaps through the facade's atomic `reload_from_store`, so a
+        promotion either lands on every replica or on none."""
+        if self.canary is not None:
+            return self
+        store = self._store
+        if store is None:
+            raise RuntimeError(
+                "no store bound: construct the fleet with from_store() or "
+                "bind a store on the replicas"
+            )
+        from cobalt_smart_lender_ai_tpu.serve.canary import CanaryController
+        from cobalt_smart_lender_ai_tpu.serve.service import _registry_store
+
+        self.canary = CanaryController(
+            self,
+            _registry_store(store, self.config),
+            config=self.config,
+            clock=self._clock,
+            on_drift=on_drift,
+        )
+        try:
+            self.canary.sync_identity()
+            self.canary.refresh()
+        except Exception as exc:
+            _LOG.warning("canary_enable_degraded", error=str(exc))
+        return self
+
+    def promote_canary(self, *, force: bool = False) -> dict:
+        """``POST /admin/promote`` — gate, atomic fleet swap, channel flip."""
+        if self.canary is None:
+            from cobalt_smart_lender_ai_tpu.reliability.errors import (
+                PromotionRejected,
+            )
+
+            raise PromotionRejected(
+                "canary evaluation is not enabled on this fleet",
+                report={"eligible": False, "reasons": ["canary_not_enabled"]},
+            )
+        return self.canary.promote(force=force)
+
+    def rollback_model(self, *, reason: str = "manual") -> dict:
+        """``POST /admin/rollback`` — demote ``latest`` back to ``previous``."""
+        if self.canary is None:
+            from cobalt_smart_lender_ai_tpu.reliability.errors import (
+                RollbackFailed,
+            )
+
+            raise RollbackFailed(
+                "canary evaluation is not enabled on this fleet"
+            )
+        return self.canary.rollback(reason=reason, trigger="manual")
+
+    def drift_report(self) -> dict:
+        """``GET /drift`` — per-feature PSI vs the training snapshot."""
+        if self.canary is None:
+            return {"status": "disabled"}
+        return self.canary.drift_report()
+
     def close(self) -> None:
+        if self.canary is not None:
+            self.canary.close()
         for rep in self.replicas:
             rep.close()

@@ -775,6 +775,37 @@ class MicroBatcher:
             )
 
 
+def _registry_store(store: "ObjectStore", cfg: ServeConfig) -> "ObjectStore":
+    """The store handle registry/channel operations go through: wrapped in
+    `ResilientStore` (retry + verified `.ptr.json` reads) per the reliability
+    config, exactly as `pipeline.run_pipeline` wraps its store."""
+    from cobalt_smart_lender_ai_tpu.reliability import (
+        ResilientStore,
+        policy_from_config,
+    )
+
+    rel = cfg.reliability
+    if not rel.wrap_store or isinstance(store, ResilientStore):
+        return store
+    return ResilientStore(
+        store, policy_from_config(rel), verify_reads=rel.verify_reads
+    )
+
+
+def _resolve_latest_channel(store: "ObjectStore", cfg: ServeConfig) -> str | None:
+    """Best-effort ``latest``-channel lookup at startup — a store without a
+    model registry (every pre-registry deployment) resolves to None and the
+    static ``model_key`` behavior is unchanged."""
+    from cobalt_smart_lender_ai_tpu.io.model_registry import ModelRegistry
+
+    try:
+        return ModelRegistry(
+            _registry_store(store, cfg), prefix=cfg.registry_prefix
+        ).resolve(cfg.model_name, "latest")
+    except Exception:
+        return None
+
+
 class ScorerService:
     """Restored model + pre-compiled scorer behind the three endpoints of
     `cobalt_fast_api.py:96-143`, plus the hardening surface: `admission`
@@ -842,6 +873,10 @@ class ScorerService:
         # read `_model` once and run against that snapshot.
         self._swap_lock = threading.Lock()
         self._last_reload: dict | None = None
+        # Continuous-training loop (serve.canary): populated by
+        # `enable_canary`; None keeps the pre-registry behavior bit-for-bit.
+        self.canary = None
+        self._model_identity: dict | None = None
         self._model = _CompiledModel(artifact, self.config, device=device)
         self.batcher: MicroBatcher | None = None
         if self.config.microbatch_enabled:
@@ -953,6 +988,17 @@ class ScorerService:
             "cobalt_score_cache_entries",
             "entries currently held by the content-hash score cache",
         ).set_function(lambda: len(self._score_cache))
+        # Model identity — ONE join key for shadow-compare joins and incident
+        # forensics across /metrics, /readyz, and scoring responses. Exactly
+        # one label combination is 1 at any time; registry-aware operations
+        # (enable_canary / promote / rollback) move it via `set_model_info`.
+        self._m_model_info = reg.gauge(
+            "cobalt_model_info",
+            "1 for the model version currently serving (identity labels)",
+            ("version", "channel", "provenance_md5"),
+        )
+        self._model_info_labels = ("unversioned", "direct", "none")
+        self._m_model_info.labels(*self._model_info_labels).set(1.0)
 
     def observe_request(
         self,
@@ -974,6 +1020,9 @@ class ScorerService:
         )
         if status >= 400:
             self._m_errors.labels(route=route, code=code or "error").inc()
+        # Post-promotion guard: O(1) when no guard window is open.
+        if self.canary is not None:
+            self.canary.maybe_auto_rollback()
 
     def _observe_phase(self, name: str, duration_s: float) -> None:
         """One phase's wall time into the phase histogram AND the flight
@@ -998,6 +1047,8 @@ class ScorerService:
         """Stop the micro-batch worker (drains queued requests first);
         requests arriving afterwards score on the direct per-request path.
         Idempotent — both HTTP adapters call it at server shutdown."""
+        if self.canary is not None:
+            self.canary.close()
         if self.batcher is not None:
             self.batcher.close()
 
@@ -1063,15 +1114,28 @@ class ScorerService:
         clock: Callable[[], float] = time.monotonic,
         registry: MetricsRegistry | None = None,
         device: Any | None = None,
+        enable_canary: bool | None = None,
     ) -> "ScorerService":
         """Startup restore — the lifespan S3 download + joblib.load of
         `cobalt_fast_api.py:42-47`, run under the circuit breaker so a dead
         store fails fast on restart storms. The store handle is kept for
-        `reload_from_store`."""
+        `reload_from_store`.
+
+        With ``canary_enabled`` the model registry's ``latest`` channel (when
+        one exists for ``model_name``) overrides ``model_key``, and any
+        published ``canary`` is loaded beside the champion for shadow
+        scoring. ``enable_canary=False`` keeps the channel resolution but
+        skips attaching the controller — `ReplicaSet.from_store` uses it so
+        the fleet gets ONE facade-level controller, not one per replica."""
         cfg = config or ServeConfig()
         brk = breaker_from_config(cfg.reliability, clock=clock)
-        artifact = brk.call(lambda: GBDTArtifact.load(store, cfg.model_key))
-        return cls(
+        key = cfg.model_key
+        if cfg.canary_enabled:
+            resolved = _resolve_latest_channel(store, cfg)
+            if resolved is not None:
+                key = resolved
+        artifact = brk.call(lambda: GBDTArtifact.load(store, key))
+        svc = cls(
             artifact,
             cfg,
             store=store,
@@ -1080,6 +1144,10 @@ class ScorerService:
             registry=registry,
             device=device,
         )
+        svc._model_key = key
+        if cfg.canary_enabled and enable_canary is not False:
+            svc.enable_canary()
+        return svc
 
     # -- hot model swap --------------------------------------------------------
 
@@ -1191,6 +1259,105 @@ class ScorerService:
         self._m_reloads.labels(status="rolled_back").inc()
         _LOG.warning("model_reload", **self._last_reload)
         return self._last_reload
+
+    # -- continuous-training loop (serve.canary) ------------------------------
+
+    @property
+    def model_info(self) -> dict:
+        """Identity of the serving model — `/readyz`'s ``model`` block and
+        the ``model_version`` field of scoring responses."""
+        if self._model_identity is not None:
+            return self._model_identity
+        return {
+            "version": "unversioned",
+            "channel": "direct",
+            "provenance_md5": None,
+        }
+
+    def set_model_info(
+        self, *, version: str, channel: str, provenance_md5: str | None
+    ) -> None:
+        """Move the `cobalt_model_info` gauge to a new identity (the old
+        label combination drops to 0 so joins never see two live models)."""
+        self._model_identity = {
+            "version": version,
+            "channel": channel,
+            "provenance_md5": provenance_md5,
+        }
+        new_labels = (version, channel, provenance_md5 or "none")
+        self._m_model_info.labels(*self._model_info_labels).set(0.0)
+        self._m_model_info.labels(*new_labels).set(1.0)
+        self._model_info_labels = new_labels
+
+    def enable_canary(self, on_drift=None) -> "ScorerService":
+        """Attach the continuous-training controller (idempotent): resolves
+        the model registry in the bound store, stamps the serving model's
+        identity from the ``latest`` channel, and loads any published
+        ``canary`` for shadow scoring. Never raises on a store without a
+        registry — there is simply nothing to canary yet."""
+        if self.canary is not None:
+            return self
+        if self._store is None:
+            raise RuntimeError(
+                "no store bound: construct the service with from_store() or "
+                "pass store= explicitly"
+            )
+        from cobalt_smart_lender_ai_tpu.serve.canary import CanaryController
+
+        self.canary = CanaryController(
+            self,
+            _registry_store(self._store, self.config),
+            config=self.config,
+            clock=self._clock,
+            on_drift=on_drift,
+        )
+        try:
+            self.canary.sync_identity()
+            self.canary.refresh()
+        except Exception as exc:
+            _LOG.warning("canary_enable_degraded", error=str(exc))
+        return self
+
+    def promote_canary(self, *, force: bool = False) -> dict:
+        """``POST /admin/promote`` — gate, atomic swap, channel flip."""
+        if self.canary is None:
+            from cobalt_smart_lender_ai_tpu.reliability.errors import (
+                PromotionRejected,
+            )
+
+            raise PromotionRejected(
+                "canary evaluation is not enabled on this service",
+                report={"eligible": False, "reasons": ["canary_not_enabled"]},
+            )
+        return self.canary.promote(force=force)
+
+    def rollback_model(self, *, reason: str = "manual") -> dict:
+        """``POST /admin/rollback`` — demote ``latest`` back to ``previous``."""
+        if self.canary is None:
+            from cobalt_smart_lender_ai_tpu.reliability.errors import (
+                RollbackFailed,
+            )
+
+            raise RollbackFailed(
+                "canary evaluation is not enabled on this service"
+            )
+        return self.canary.rollback(reason=reason, trigger="manual")
+
+    def drift_report(self) -> dict:
+        """``GET /drift`` — per-feature PSI vs the training snapshot."""
+        if self.canary is None:
+            return {"status": "disabled"}
+        return self.canary.drift_report()
+
+    def _canary_tap(
+        self,
+        row: Mapping[str, float],
+        prob: float,
+        latency_s: float | None,
+    ) -> None:
+        can = self.canary
+        if can is not None:
+            can.tap(row, prob, latency_s)
 
     # -- scoring helpers ------------------------------------------------------
 
@@ -1319,6 +1486,10 @@ class ScorerService:
             payload["shap_error"] = model.shap_error
         if self._last_reload is not None:
             payload["last_reload"] = self._last_reload
+        payload["model"] = self.model_info
+        if self.canary is not None:
+            self.canary.maybe_auto_rollback()
+            payload["canary"] = self.canary.status()
         return ready, payload
 
     # -- endpoint handlers ----------------------------------------------------
@@ -1349,13 +1520,19 @@ class ScorerService:
             if cached is not None:
                 self._m_cache_hits.inc()
                 prob, phis_row, base = cached
-                return {
+                resp = {
                     "prob_default": prob,
                     "features": list(model.feature_names),
                     "input_row": dict(row),
                     "shap_values": list(phis_row),
                     "base_value": base,
                 }
+                if self._model_identity is not None:
+                    resp["model_version"] = self._model_identity["version"]
+                # The canary has no cache: a hit still shadow-scores, so the
+                # comparison window keeps filling under cache-friendly load.
+                self._canary_tap(row, prob, None)
+                return resp
             self._m_cache_misses.inc()
         batcher = self.batcher
         fut = None
@@ -1398,9 +1575,12 @@ class ScorerService:
                     (resp["prob_default"], resp["shap_values"], resp["base_value"]),
                     model=cache_model,
                 )
+            if self._model_identity is not None:
+                resp["model_version"] = self._model_identity["version"]
+            self._canary_tap(row, prob, phases.get("dispatch"))
             return resp
         model = self._model
-        with self.phase("dispatch"):
+        with self.phase("dispatch") as dispatch_sp:
             x = model.row_array(row)
             margin = model.margin_fn(x)
             prob = float(jax.nn.sigmoid(margin)[0])
@@ -1446,6 +1626,9 @@ class ScorerService:
                 (resp["prob_default"], resp["shap_values"], resp["base_value"]),
                 model=cache_model,
             )
+        if self._model_identity is not None:
+            resp["model_version"] = self._model_identity["version"]
+        self._canary_tap(row, prob, dispatch_sp.duration_s)
         return resp
 
     def predict_bulk_csv(

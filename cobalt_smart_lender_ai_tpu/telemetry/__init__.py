@@ -28,6 +28,10 @@ three legs:
 
 from __future__ import annotations
 
+from cobalt_smart_lender_ai_tpu.telemetry.drift import (
+    FeatureSketch,
+    psi,
+)
 from cobalt_smart_lender_ai_tpu.telemetry.flight import (
     META_ROUTES,
     FlightRecorder,
@@ -80,6 +84,7 @@ __all__ = [
     "OPENMETRICS_CONTENT_TYPE",
     "TRACE_CONTENT_TYPE",
     "Counter",
+    "FeatureSketch",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -101,6 +106,7 @@ __all__ = [
     "log_buckets",
     "new_request_id",
     "parse_exposition",
+    "psi",
     "record_span",
     "render",
     "render_chrome_trace",

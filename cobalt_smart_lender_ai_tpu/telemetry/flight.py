@@ -70,6 +70,7 @@ META_ROUTES: frozenset[str] = frozenset(
         "/readyz",
         "/metrics",
         "/slo",
+        "/drift",
         "/debug/requests",
         "/debug/slowest",
         "/debug/trace",

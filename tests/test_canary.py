@@ -1,0 +1,493 @@
+"""The continuous-training loop end to end: drift sketches/PSI units, the
+canary shadow tap, the promotion gate (rejecting a label-shuffled degraded
+candidate with a structured reason), atomic fleet promotion with score-cache
+invalidation, SLO-burn automatic rollback inside the guard window, the /drift
++ /readyz + /metrics observability surface, and the chaos drill (typed errors
+only, pointers never torn, canary scores never in a caller's response)."""
+
+import json
+import shutil
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cobalt_smart_lender_ai_tpu.config import ServeConfig
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+from cobalt_smart_lender_ai_tpu.io.model_registry import ModelRegistry
+from cobalt_smart_lender_ai_tpu.reliability.errors import (
+    PromotionRejected,
+    RollbackFailed,
+)
+from cobalt_smart_lender_ai_tpu.serve.canary import rank_correlation
+from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+from cobalt_smart_lender_ai_tpu.telemetry.drift import FeatureSketch, psi
+from tools.retrain import retrain_candidate
+
+
+class ManualClock:
+    def __init__(self, start: float = 100.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, s: float) -> None:
+        self.now += s
+
+
+_MINI = dict(rows=1200, n_estimators=8, max_depth=3, train_mlp=False)
+
+
+@pytest.fixture(scope="module")
+def seeded_lake(tmp_path_factory):
+    """One miniature retrain, bootstrapped to `latest` (with the MLP
+    challenger) — copied per test so registry mutations stay isolated."""
+    root = tmp_path_factory.mktemp("canary") / "lake"
+    store = ObjectStore(str(root))
+    report = retrain_candidate(
+        store, rows=1200, seed=5, n_estimators=8, max_depth=3,
+        train_mlp=True, mlp_epochs=2, bootstrap=True,
+    )
+    return str(root), report
+
+
+@pytest.fixture
+def lake(seeded_lake, tmp_path):
+    src, _ = seeded_lake
+    dst = tmp_path / "lake"
+    shutil.copytree(src, dst)
+    return ObjectStore(str(dst))
+
+
+def _cfg(**kw) -> ServeConfig:
+    base = dict(
+        canary_enabled=True,
+        microbatch_enabled=False,
+        prewarm_all_buckets=False,
+        canary_sample_rate=1.0,
+        canary_min_samples=6,
+        # shadow vs request-path timings are both sub-ms here; a real ratio
+        # bound would flake, and the check itself is still exercised
+        canary_max_latency_ratio=1000.0,
+        drift_min_samples=8,
+    )
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _rows_from(X: np.ndarray, n: int, start: int = 0) -> list[dict]:
+    out = []
+    for i in range(start, start + n):
+        row = {}
+        for j, f in enumerate(schema.SERVING_FEATURES):
+            v = float(X[i % len(X), j])
+            if not np.isfinite(v):
+                v = 0.0  # request validation requires finite numbers
+            row[f] = int(v) if f in schema.SERVING_INT_FEATURES else v
+        out.append(row)
+    return out
+
+
+# --- units: PSI / sketches / rank correlation ---------------------------------
+
+
+def test_feature_sketch_psi():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2000, 3))
+    names = ["a", "b", "c"]
+    base = FeatureSketch.from_data(X, names, bins=10)
+    assert base.n == 2000
+
+    # same distribution -> tiny PSI; shifted distribution -> large PSI on
+    # exactly the shifted feature; NaNs land in the missing bin and count
+    live = base.empty_like()
+    live.observe(rng.normal(size=(1000, 3)))
+    same = base.psi_vs(live)
+    assert all(v < 0.1 for v in same.values())
+
+    shifted = base.empty_like()
+    Y = rng.normal(size=(1000, 3))
+    Y[:, 1] += 5.0
+    shifted.observe(Y)
+    drifted = base.psi_vs(shifted)
+    assert drifted["b"] > 0.25
+    assert drifted["a"] < 0.1 and drifted["c"] < 0.1
+
+    nan_live = base.empty_like()
+    Z = rng.normal(size=(500, 3))
+    Z[:, 2] = np.nan
+    nan_live.observe(Z)
+    assert base.psi_vs(nan_live)["c"] > 0.25  # missing-rate drift scores too
+
+    # JSON round-trip (what rides in the registry provenance record)
+    back = FeatureSketch.from_json(base.to_json())
+    assert back.feature_names == names and back.n == 2000
+    np.testing.assert_array_equal(back.counts, base.counts)
+    assert psi(base.counts[0], base.counts[0]) == pytest.approx(0.0)
+
+
+def test_feature_sketch_observe_row_by_name():
+    base = FeatureSketch.from_data(
+        np.random.default_rng(1).normal(size=(200, 2)), ["x", "y"]
+    )
+    live = base.empty_like()
+    live.observe_row({"x": 0.1, "y": -0.2})
+    live.observe_row({"x": 0.3})  # missing feature -> NaN bin, not a crash
+    assert live.n == 2
+    assert live.counts[1, -1] == 1
+
+
+def test_rank_correlation_is_nan_safe():
+    a = np.linspace(0.0, 1.0, 50)
+    assert rank_correlation(a, a) == pytest.approx(1.0)
+    assert rank_correlation(a, 1.0 - a) == pytest.approx(-1.0)
+    # constant vector — the label-shuffled-candidate signature — reads as
+    # zero agreement, never NaN
+    assert rank_correlation(a, np.full(50, 0.3)) == 0.0
+    assert rank_correlation(np.asarray([1.0]), np.asarray([1.0])) == 0.0
+
+
+# --- retrain driver -----------------------------------------------------------
+
+
+def test_retrain_publishes_canary_with_provenance(seeded_lake):
+    src, report = seeded_lake
+    reg = ModelRegistry(ObjectStore(src))
+    # bootstrap promoted the first champion; nothing left in canary
+    assert report["bootstrapped"] and report["channel"] == "latest"
+    assert reg.channel("gbdt", "latest")["version"] == 1
+    assert reg.channel("gbdt", "canary") is None
+    record = reg.record("gbdt", 1)
+    prov = record.provenance
+    assert prov["dataset_md5"] and prov["config_hash"]
+    sketch = FeatureSketch.from_json(prov["feature_sketch"])
+    assert sketch.feature_names == list(schema.SERVING_FEATURES)
+    assert sketch.n > 0
+    assert record.metrics["test_auc"] > 0.5
+    # the MLP challenger trained and published under its own name, to canary
+    assert report["challenger"]["model"] == "gbdt_mlp"
+    assert reg.channel("gbdt_mlp", "canary")["version"] == 1
+    assert reg.record("gbdt_mlp", 1).kind == "MLPArtifact"
+
+
+# --- the loop end to end (the ISSUE acceptance drill) -------------------------
+
+
+def test_canary_loop_end_to_end_across_replicas(lake, serving_artifact):
+    """Degraded candidate rejected with a structured reason; good candidate
+    promoted atomically across both replicas (score caches invalidated);
+    post-promotion SLO fast burn auto-rolls back to `previous` inside the
+    guard window — all observable via model_info / metrics / readyz."""
+    from cobalt_smart_lender_ai_tpu.serve.replicas import ReplicaSet
+
+    _, X = serving_artifact
+    clock = ManualClock()
+    cfg = _cfg(replicas=2, replica_devices=False, score_cache_size=64)
+    fleet = ReplicaSet.from_store(lake, cfg, clock=clock)
+    try:
+        assert isinstance(fleet, ReplicaSet)
+        assert fleet.model_info == {
+            "version": "v1", "channel": "latest",
+            "provenance_md5": ModelRegistry(lake).channel("gbdt", "latest")["md5"],
+        }
+        v1_key = "models/gbdt/v1"
+        assert all(r._model_key == v1_key for r in fleet.replicas)
+
+        # -- a label-shuffled candidate lands in canary and is REJECTED ----
+        retrain_candidate(lake, seed=6, degrade=True, **_MINI)
+        fleet.canary.refresh()
+        assert fleet.canary.status()["loaded"]
+        rows = _rows_from(X, 16)
+        for row in rows:
+            resp = fleet.predict_single(row)
+            assert resp["model_version"] == "v1"
+            assert "canary" not in resp  # shadow result never leaks out
+        assert fleet.canary.flush()
+        with pytest.raises(PromotionRejected) as exc:
+            fleet.promote_canary()
+        report = exc.value.report
+        assert not report["eligible"] and report["reasons"]
+        assert any(
+            r.startswith(("score_correlation", "score_delta"))
+            for r in report["reasons"]
+        ), report["reasons"]
+        # nothing moved: the fleet and the registry still serve v1
+        assert ModelRegistry(lake).channel("gbdt", "latest")["version"] == 1
+        assert all(r._model_key == v1_key for r in fleet.replicas)
+
+        # -- a good candidate passes the gate and lands fleet-wide ---------
+        retrain_candidate(lake, seed=5, **_MINI)  # same regime as champion
+        fleet.canary.refresh()
+        for row in rows:
+            fleet.predict_single(row)
+        # warm both replicas' score caches, then promotion must clear them
+        for _ in range(4):
+            fleet.predict_single(rows[0])
+        assert sum(len(r._score_cache) for r in fleet.replicas) > 0
+        assert fleet.canary.flush()
+        result = fleet.promote_canary()
+        assert result["status"] == "promoted"
+        assert result["promoted_version"] == 3 and result["previous_version"] == 1
+        assert result["gate"]["checks"]["score_rank_correlation"] > 0.9
+        v3_key = "models/gbdt/v3"
+        assert all(r._model_key == v3_key for r in fleet.replicas)
+        assert all(len(r._score_cache) == 0 for r in fleet.replicas)
+        assert fleet.model_info["version"] == "v3"
+        assert fleet.predict_single(rows[0])["model_version"] == "v3"
+        reg = ModelRegistry(lake)
+        assert reg.channel("gbdt", "latest")["version"] == 3
+        assert reg.channel("gbdt", "previous")["version"] == 1
+        assert reg.channel("gbdt", "canary") is None
+        ok, payload = fleet.ready()
+        assert ok and payload["model"]["version"] == "v3"
+        assert payload["canary"]["guard"]["promoted_version"] == 3
+
+        # -- SLO fast burn inside the guard window: automatic rollback -----
+        clock.advance(1.0)
+        for _ in range(5):
+            fleet.observe_request("/predict", 500, 0.001)
+        assert fleet.model_info["version"] == "v1"
+        assert all(r._model_key == v1_key for r in fleet.replicas)
+        latest = reg.channel("gbdt", "latest")
+        assert latest["version"] == 1 and latest["rolled_back_from"] == 3
+        assert reg.channel("gbdt", "previous")["version"] == 3  # forensics
+        _, payload = fleet.ready()
+        assert payload["canary"]["guard"] is None
+        assert payload["canary"]["last_promotion"]["action"] == "rolled_back"
+        assert payload["canary"]["last_promotion"]["trigger"] == "slo_fast_burn"
+
+        # the whole story is on /metrics
+        text = fleet.registry.render()
+        assert 'cobalt_model_info{version="v1",channel="latest"' in text
+        assert (
+            'cobalt_canary_promotions_total{outcome="rejected"} 1' in text
+        )
+        assert (
+            'cobalt_canary_promotions_total{outcome="promoted"} 1' in text
+        )
+        assert (
+            'cobalt_canary_rollbacks_total{trigger="slo_fast_burn"} 1' in text
+        )
+        assert "cobalt_canary_shadow_total" in text
+        assert "cobalt_drift_max_psi" in text
+    finally:
+        fleet.close()
+
+
+# --- drift detection ----------------------------------------------------------
+
+
+def test_drift_alarm_fires_once_and_can_trigger_retrain(lake, serving_artifact):
+    _, X = serving_artifact
+    alarms = []
+    cfg = _cfg(canary_enabled=False, model_key="models/gbdt/v1")
+    svc = ScorerService.from_store(lake, cfg)
+    try:
+        svc.enable_canary(on_drift=alarms.append)  # the retrain hook
+        assert svc.model_info["version"] == "v1"
+        report = svc.drift_report()
+        assert report["status"] == "ok" and report["n_live"] == 0
+        assert report["max_psi"] is None  # below min samples: no alarm
+
+        # live traffic from far outside the training distribution
+        for row in _rows_from(X * 1000.0, 12):
+            svc.canary.tap(row, 0.5, None)
+        assert svc.canary.flush()
+        report = svc.drift_report()
+        assert report["alarm"] and report["max_psi"] > 0.25
+        assert report["n_live"] == 12
+        assert set(report["features"]) == set(schema.SERVING_FEATURES)
+        assert len(alarms) == 1 and alarms[0]["status"] == "ok"
+
+        # edge-triggered: staying in alarm does not re-fire the hook
+        for row in _rows_from(X * 1000.0, 4, start=12):
+            svc.canary.tap(row, 0.5, None)
+        assert svc.canary.flush()
+        assert len(alarms) == 1
+
+        text = svc.registry.render()
+        assert "cobalt_drift_alarm 1" in text
+        assert 'cobalt_drift_psi{feature="loan_amnt"}' in text
+    finally:
+        svc.close()
+
+
+# --- HTTP surface -------------------------------------------------------------
+
+
+def _http(base, path, payload=None, method=None):
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        base + path, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        body = err.read()
+        return err.code, json.loads(body) if body else {}
+
+
+@pytest.fixture
+def live_service(lake):
+    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+
+    svc = ScorerService.from_store(lake, _cfg())
+    httpd = make_server(svc, "127.0.0.1", 0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield svc, f"http://127.0.0.1:{httpd.server_address[1]}"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        svc.close()
+
+
+def test_http_canary_surface(live_service):
+    svc, base = live_service
+
+    status, ready = _http(base, "/readyz")
+    assert status == 200
+    assert ready["model"]["version"] == "v1"
+    assert ready["model"]["channel"] == "latest"
+    assert ready["canary"]["enabled"] and not ready["canary"]["loaded"]
+
+    status, drift = _http(base, "/drift")
+    assert status == 200 and drift["status"] == "ok"
+
+    # no canary published: promote is a typed 409 with the structured report
+    status, body = _http(base, "/admin/promote", payload={})
+    assert status == 409
+    assert body["error"] == "promotion_rejected"
+    assert body["report"]["reasons"] == ["no_canary"]
+
+    # nothing to restore either: typed 409, champion untouched
+    status, body = _http(base, "/admin/rollback", payload={"reason": "x"})
+    assert status == 409 and body["error"] == "rollback_failed"
+    assert svc.model_info["version"] == "v1"
+
+    from cobalt_smart_lender_ai_tpu.telemetry import parse_exposition
+
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+        text = resp.read().decode()
+    parse_exposition(text)
+    assert 'cobalt_model_info{version="v1",channel="latest"' in text
+    assert "cobalt_canary_loaded 0" in text
+
+
+# --- chaos: the loop under injected faults ------------------------------------
+
+
+@pytest.mark.faults
+def test_canary_cycle_under_faults_yields_typed_errors_only(lake, serving_artifact):
+    """Publish/shadow/promote/rollback over live HTTP against a store
+    dropping calls and injecting latency: every response is 2xx or a TYPED
+    error (zero untyped 500s), channel pointers are never torn, and no
+    response ever carries a canary score."""
+    from cobalt_smart_lender_ai_tpu.reliability import ResilientStore, RetryPolicy
+    from cobalt_smart_lender_ai_tpu.reliability.faults import (
+        FaultInjectingStore,
+        FaultSpec,
+    )
+    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.telemetry import MetricsRegistry
+
+    _, X = serving_artifact
+    flaky = FaultInjectingStore(
+        lake,
+        seed=29,
+        faults={
+            "put": FaultSpec(rate=0.2, max_faults=25, delay_s=0.001),
+            "get": FaultSpec(rate=0.15, max_faults=25, delay_s=0.001),
+            "exists": FaultSpec(rate=0.1, max_faults=15),
+        },
+        sleep=lambda s: None,
+        registry=MetricsRegistry(),
+    )
+    store = ResilientStore(
+        flaky,
+        RetryPolicy(max_attempts=6, base_delay_s=0.0, jitter=0.0),
+        verify_reads=True,
+    )
+    svc = ScorerService.from_store(store, _cfg(canary_min_samples=4))
+    httpd = make_server(svc, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    allowed_codes = {
+        "promotion_rejected", "rollback_failed", "reload_failed",
+        "circuit_open", "shed",
+    }
+    resp_keys = {
+        "prob_default", "shap_values", "base_value", "features",
+        "input_row", "model_version", "degraded",
+    }
+    observed = []
+
+    def check(status, body):
+        observed.append((status, body))
+        if status >= 400:
+            assert body.get("error") in allowed_codes, (status, body)
+        return status, body
+
+    def pointers_whole():
+        reg = ModelRegistry(lake)  # the clean inner view
+        for ch in ("latest", "canary", "previous"):
+            ptr = reg.channel("gbdt", ch)
+            if ptr is not None:
+                assert reg.record("gbdt", int(ptr["version"])).key == ptr["key"]
+                GBDTArtifact.load(lake, ptr["key"])
+
+    try:
+        # an identical-regime candidate: publishes retry through the faults
+        art = GBDTArtifact.load(lake, "models/gbdt/v1")
+        ModelRegistry(store).publish("gbdt", art)
+        pointers_whole()
+
+        # premature promote: empty window -> typed 409, never untyped
+        check(*_http(base, "/admin/promote", payload={}))
+
+        rows = _rows_from(X, 10)
+        for row in rows:
+            status, body = check(*_http(base, "/predict", payload=row))
+            if status == 200:
+                assert set(body) <= resp_keys, set(body)
+        svc.canary.refresh()
+        for row in rows:
+            check(*_http(base, "/predict", payload=row))
+        assert svc.canary.flush()
+
+        promoted = False
+        for _ in range(5):
+            status, body = check(*_http(base, "/admin/promote", payload={}))
+            pointers_whole()
+            if status == 200:
+                promoted = body["status"] == "promoted"
+                break
+        assert promoted, observed[-1]
+        assert svc.model_info["version"] == "v2"
+
+        for _ in range(5):
+            status, body = check(
+                *_http(base, "/admin/rollback", payload={"reason": "chaos"})
+            )
+            pointers_whole()
+            if status == 200:
+                break
+        assert status == 200 and body["status"] == "rolled_back"
+        assert svc.model_info["version"] == "v1"
+
+        assert flaky.injected.total() > 0  # the drill actually injected
+        assert all(
+            s < 500 or b.get("error") in allowed_codes for s, b in observed
+        )
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        svc.close()

@@ -1,0 +1,210 @@
+"""Model registry: versioned publishes, channel-pointer semantics
+(latest/canary/previous), promote/rollback flips, keep-last-K GC — and the
+chaos drill: pointer writes under injected store faults are atomic (stale is
+allowed, torn is not)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+from cobalt_smart_lender_ai_tpu.io.model_registry import (
+    CHANNELS,
+    ModelRegistry,
+    ModelVersion,
+)
+
+
+@pytest.fixture
+def lake(tmp_path, serving_artifact):
+    """Private store + the session artifact to publish from."""
+    shared, _ = serving_artifact
+    art = GBDTArtifact.load(shared, "models/gbdt/model_tree")
+    return ObjectStore(str(tmp_path / "lake")), art
+
+
+def test_publish_mints_versions_with_provenance(lake):
+    store, art = lake
+    reg = ModelRegistry(store)
+    mv = reg.publish(
+        "gbdt", art, provenance={"dataset_md5": "abc", "config_hash": "ff"}
+    )
+    assert (mv.name, mv.version, mv.kind) == ("gbdt", 1, "GBDTArtifact")
+    assert mv.key == "models/gbdt/v1"
+    assert mv.parent_version is None
+    assert mv.provenance["dataset_md5"] == "abc"
+    # record round-trips, artifact restores from the versioned key, and the
+    # stored npz hashes back to the recorded md5
+    back = reg.record("gbdt", 1)
+    assert back == mv
+    assert isinstance(back, ModelVersion)
+    restored = GBDTArtifact.load(store, mv.key)
+    assert restored.feature_names == art.feature_names
+    assert reg.verify("gbdt", 1)
+    # content pin written: ResilientStore verified reads cover the model blob
+    assert store.exists(mv.key + ".npz.ptr.json")
+    # default channel is canary, never latest
+    assert reg.resolve("gbdt", "canary") == mv.key
+    assert reg.resolve("gbdt", "latest") is None
+    assert reg.names() == ["gbdt"]
+    assert reg.versions("gbdt") == [1]
+
+
+def test_publish_is_write_once(lake):
+    store, art = lake
+    reg = ModelRegistry(store)
+    reg.publish("gbdt", art)
+    # registry invariant: a version record is immutable once minted
+    reg._next_version = lambda name: 1
+    with pytest.raises(FileExistsError):
+        reg.publish("gbdt", art)
+
+
+def test_promote_and_rollback_flips(lake):
+    store, art = lake
+    reg = ModelRegistry(store)
+    reg.publish("gbdt", art)
+    flip = reg.promote("gbdt")
+    assert flip["promoted_version"] == 1 and flip["previous_version"] is None
+    assert reg.resolve("gbdt", "latest") == "models/gbdt/v1"
+    assert reg.channel("gbdt", "canary") is None  # pointer cleared
+
+    mv2 = reg.publish("gbdt", art)
+    assert mv2.version == 2 and mv2.parent_version == 1
+    flip = reg.promote("gbdt")
+    assert flip["promoted_version"] == 2 and flip["previous_version"] == 1
+    assert reg.channel("gbdt", "latest")["version"] == 2
+    assert reg.channel("gbdt", "previous")["version"] == 1
+
+    back = reg.rollback("gbdt", reason="slo burn")
+    assert back["restored_version"] == 1 and back["demoted_version"] == 2
+    latest = reg.channel("gbdt", "latest")
+    assert latest["version"] == 1
+    assert latest["rolled_back_from"] == 2 and latest["reason"] == "slo burn"
+    # the demoted champion stays reachable for forensics
+    assert reg.channel("gbdt", "previous")["version"] == 2
+
+
+def test_promote_and_rollback_require_their_channels(lake):
+    store, art = lake
+    reg = ModelRegistry(store)
+    with pytest.raises(LookupError):
+        reg.promote("gbdt")  # nothing published
+    reg.publish("gbdt", art)
+    reg.promote("gbdt")
+    with pytest.raises(LookupError):
+        reg.rollback("gbdt")  # no previous yet
+
+
+def test_channel_pointer_guards(lake):
+    store, art = lake
+    reg = ModelRegistry(store)
+    reg.publish("gbdt", art)
+    with pytest.raises(ValueError, match="unknown channel"):
+        reg.set_channel("gbdt", "prod", 1)
+    with pytest.raises(FileNotFoundError):
+        reg.set_channel("gbdt", "latest", 99)  # pointers never dangle
+
+
+def test_gc_keeps_channel_pinned_and_last_k(lake):
+    store, art = lake
+    reg = ModelRegistry(store)
+    for _ in range(4):
+        reg.publish("gbdt", art, channel=None)
+    reg.set_channel("gbdt", "latest", 1)  # pin an old version
+
+    dry = reg.gc(keep_last=1, dry_run=True)
+    assert dry["dry_run"] and dry["models"]["gbdt"]["deleted"] == [2, 3]
+    assert store.exists("models/gbdt/v2.npz")  # dry-run touched nothing
+
+    applied = reg.gc(keep_last=1, dry_run=False)
+    assert applied["models"]["gbdt"] == {"kept": [1, 4], "deleted": [2, 3]}
+    assert not store.exists("models/gbdt/v2.npz")
+    assert not store.exists("registry/models/gbdt/v3.json")
+    assert store.exists("models/gbdt/v1.npz")  # channel-pinned survives
+    assert store.exists("models/gbdt/v4.npz")  # newest survives
+    assert reg.versions("gbdt") == [1, 4]
+    # the pinned pointer still resolves to a loadable artifact
+    GBDTArtifact.load(store, reg.resolve("gbdt", "latest"))
+
+
+def test_registry_gc_cli_dry_run(lake, capsys):
+    store, art = lake
+    reg = ModelRegistry(store)
+    for _ in range(3):
+        reg.publish("gbdt", art, channel=None)
+    from tools.registry_gc import main as gc_main
+
+    gc_main(["--store", store.uri, "--keep-last", "1"])
+    report = json.loads(capsys.readouterr().out)
+    assert report["dry_run"] is True
+    assert report["models"]["gbdt"]["deleted"] == [1, 2]
+    assert store.exists("models/gbdt/v1.npz")  # nothing deleted
+
+
+# --- chaos: pointers under injected faults ------------------------------------
+
+
+def _assert_no_torn_pointers(store: ObjectStore, reg: ModelRegistry) -> None:
+    """The continuous-training invariant: every channel pointer that exists
+    parses as JSON, names a version whose record exists, and its artifact
+    restores. Stale is acceptable after a fault; torn or dangling is not."""
+    for name in reg.names():
+        for ch in CHANNELS:
+            key = reg._channel_key(name, ch)
+            if not store.exists(key):
+                continue
+            ptr = json.loads(store.get_bytes(key).decode())
+            assert {"name", "channel", "version", "key"} <= set(ptr)
+            record = reg.record(name, int(ptr["version"]))
+            assert record.key == ptr["key"]
+            GBDTArtifact.load(store, ptr["key"])
+
+
+@pytest.mark.faults
+def test_publish_promote_rollback_cycle_under_faults(tmp_path, serving_artifact):
+    """Drive full canary lifecycles against a store dropping ~1 in 5 calls
+    (plus injected latency): with `ResilientStore` retries every cycle
+    completes, and after EVERY step the channel pointers are whole."""
+    from cobalt_smart_lender_ai_tpu.reliability import ResilientStore, RetryPolicy
+    from cobalt_smart_lender_ai_tpu.reliability.faults import (
+        FaultInjectingStore,
+        FaultSpec,
+    )
+    from cobalt_smart_lender_ai_tpu.telemetry import MetricsRegistry
+
+    shared, _ = serving_artifact
+    art = GBDTArtifact.load(shared, "models/gbdt/model_tree")
+    inner = ObjectStore(str(tmp_path / "lake"))
+    flaky = FaultInjectingStore(
+        inner,
+        seed=13,
+        faults={
+            "put": FaultSpec(rate=0.2, max_faults=40),
+            "get": FaultSpec(rate=0.15, max_faults=40),
+            "exists": FaultSpec(rate=0.1, max_faults=20),
+            "delete": FaultSpec(rate=0.2, max_faults=10),
+        },
+        sleep=lambda s: None,
+        registry=MetricsRegistry(),
+    )
+    store = ResilientStore(
+        flaky,
+        RetryPolicy(max_attempts=6, base_delay_s=0.0, jitter=0.0),
+        verify_reads=True,
+    )
+    reg = ModelRegistry(store)
+
+    reg.publish("gbdt", art)
+    _assert_no_torn_pointers(store, reg)
+    reg.promote("gbdt")
+    _assert_no_torn_pointers(store, reg)
+    for cycle in range(2):
+        reg.publish("gbdt", art)
+        _assert_no_torn_pointers(store, reg)
+        reg.promote("gbdt")
+        _assert_no_torn_pointers(store, reg)
+        reg.rollback("gbdt", reason=f"cycle {cycle}")
+        _assert_no_torn_pointers(store, reg)
+    assert flaky.injected.total() > 0  # the drill actually injected faults

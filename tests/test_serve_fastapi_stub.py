@@ -208,12 +208,15 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
         "/predict_bulk_csv",
         "/feature_importance_bulk",
         "/admin/reload",
+        "/admin/promote",
+        "/admin/rollback",
     }
     assert set(app.get_routes) == {
         "/healthz",
         "/readyz",
         "/metrics",
         "/slo",
+        "/drift",
         "/debug/requests",
         "/debug/slowest",
         "/debug/trace",
