@@ -137,9 +137,9 @@ def main() -> None:
     )
     args = parser.parse_args()
 
-    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+    from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
 
-    enable_persistent_compile_cache()
+    bootstrap_compile_cache()
     if args.protocol:
         from cobalt_smart_lender_ai_tpu.debug import profile_trace as _trace
 

@@ -1,0 +1,184 @@
+"""Framework-default persistent XLA compile cache + compile telemetry.
+
+Every long-running entrypoint (pipeline, parity, retrain, serving, benches)
+calls `bootstrap_compile_cache` at startup. It does two independent things:
+
+1. Points JAX's persistent compilation cache at a shared on-disk directory
+   (via `debug.enable_persistent_compile_cache`), so identical programs are
+   compiled once *ever* per machine rather than once per process. Cold
+   protocol runs on the tunneled backend spend 40-400s per program in XLA;
+   a warm cache turns that into a disk read.
+2. Registers `jax.monitoring` listeners that fold JAX's own compile events
+   into the telemetry registry as the ``cobalt_compile_*`` families, so
+   `/metrics`, bench JSONs and CI can prove statements like "the second
+   process start compiled nothing".
+
+Both are idempotent and degrade to no-ops (unwritable cache dir, missing
+monitoring API) rather than failing the caller. Opt out of caching entirely
+with ``COBALT_COMPILE_CACHE=0`` — telemetry listeners stay on regardless,
+since knowing the compile wall is useful precisely when caching is off.
+
+Exposed metrics (all from JAX's event stream, not wall-clock guesses):
+
+- ``cobalt_compile_total`` / ``cobalt_compile_seconds`` — backend (XLA)
+  compilations and their durations.
+- ``cobalt_compile_cache_hits_total`` / ``cobalt_compile_cache_misses_total``
+  — persistent-cache lookups.
+- ``cobalt_compile_cache_saved_seconds_total`` — compile seconds the cache
+  avoided (JAX's own estimate, recorded on each hit).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from cobalt_smart_lender_ai_tpu.config import CompileCacheConfig
+from cobalt_smart_lender_ai_tpu.telemetry import default_registry, log_buckets
+
+# jax.monitoring event names (stable across jax 0.4.x; verified against the
+# pinned install). Durations and counters arrive on separate listener APIs.
+_EV_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+_EV_CACHE_HIT = "/jax/compilation_cache/cache_hits"
+_EV_CACHE_MISS = "/jax/compilation_cache/cache_misses"
+_EV_SAVED_SECS = "/jax/compilation_cache/compile_time_saved_sec"
+
+_DISABLE_ENV = "COBALT_COMPILE_CACHE"
+_MIN_SECS_ENV = "COBALT_COMPILE_CACHE_MIN_SECS"
+
+_bootstrapped: str | None = None
+_bootstrap_done = False
+_listeners_installed = False
+
+
+def _metrics() -> dict[str, Any]:
+    reg = default_registry()
+    return {
+        "compiles": reg.counter(
+            "cobalt_compile_total",
+            "XLA backend compilations performed by this process",
+        ),
+        "compile_seconds": reg.histogram(
+            "cobalt_compile_seconds",
+            "wall seconds per XLA backend compilation",
+            buckets=log_buckets(1e-3, 600.0, per_decade=3),
+        ),
+        "hits": reg.counter(
+            "cobalt_compile_cache_hits_total",
+            "persistent compile cache hits",
+        ),
+        "misses": reg.counter(
+            "cobalt_compile_cache_misses_total",
+            "persistent compile cache misses",
+        ),
+        "saved_seconds": reg.counter(
+            "cobalt_compile_cache_saved_seconds_total",
+            "compile seconds avoided by persistent-cache hits",
+        ),
+    }
+
+
+def install_compile_telemetry() -> bool:
+    """Register jax.monitoring listeners feeding ``cobalt_compile_*``.
+
+    Idempotent; returns False when the monitoring API is unavailable.
+    Listeners are process-global and cannot be unregistered, so they write
+    through to `default_registry()` at call time rather than capturing
+    metric objects from a registry that tests may reset.
+    """
+    global _listeners_installed
+    if _listeners_installed:
+        return True
+    try:
+        from jax import monitoring
+    except ImportError:  # pragma: no cover - jax always ships monitoring
+        return False
+
+    def _on_event(event: str, **kw: Any) -> None:
+        m = _metrics()
+        if event == _EV_CACHE_HIT:
+            m["hits"].inc()
+        elif event == _EV_CACHE_MISS:
+            m["misses"].inc()
+
+    def _on_duration(event: str, duration_secs: float, **kw: Any) -> None:
+        m = _metrics()
+        if event == _EV_BACKEND_COMPILE:
+            m["compiles"].inc()
+            m["compile_seconds"].observe(duration_secs)
+        elif event == _EV_SAVED_SECS:
+            m["saved_seconds"].inc(max(0.0, duration_secs))
+
+    try:
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # pragma: no cover - defensive: API drift
+        return False
+    _listeners_installed = True
+    return True
+
+
+def bootstrap_compile_cache(
+    config: CompileCacheConfig | None = None,
+) -> str | None:
+    """Enable the persistent compile cache with config/env policy applied.
+
+    The single bootstrap shared by every entrypoint (pipeline, parity,
+    retrain, serve, bench, tools): one source of truth for the cache dir
+    and the min-compile-time persistence threshold. Precedence:
+
+    - ``COBALT_COMPILE_CACHE=0|false|off|no`` disables caching outright
+      (telemetry listeners still install).
+    - ``COBALT_COMPILE_CACHE_MIN_SECS`` overrides the persistence
+      threshold (CI smoke sets 0 so millisecond CPU compiles persist).
+    - ``JAX_COMPILATION_CACHE_DIR`` overrides the directory (handled by
+      `debug.enable_persistent_compile_cache`).
+    - Otherwise ``config`` (default `CompileCacheConfig()`) decides.
+
+    Idempotent: the first call wins and later calls return its result, so
+    library code may call this freely without clobbering an entrypoint's
+    explicit configuration. Returns the cache dir in effect, or None when
+    caching is disabled or the directory is unwritable.
+    """
+    global _bootstrapped, _bootstrap_done
+    install_compile_telemetry()
+    if _bootstrap_done:
+        return _bootstrapped
+    cfg = config or CompileCacheConfig()
+    if os.environ.get(_DISABLE_ENV, "").strip().lower() in (
+        "0", "false", "off", "no",
+    ):
+        _bootstrap_done = True
+        _bootstrapped = None
+        return None
+    if not cfg.enabled:
+        _bootstrap_done = True
+        _bootstrapped = None
+        return None
+    min_secs = cfg.min_compile_time_secs
+    env_min = os.environ.get(_MIN_SECS_ENV)
+    if env_min is not None:
+        try:
+            min_secs = float(env_min)
+        except ValueError:
+            pass
+    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+
+    _bootstrapped = enable_persistent_compile_cache(
+        cfg.cache_dir, min_compile_time_secs=min_secs
+    )
+    _bootstrap_done = True
+    return _bootstrapped
+
+
+def compile_stats() -> dict[str, float]:
+    """Current ``cobalt_compile_*`` counter values, for bench JSONs and CI
+    assertions ("second process: hits > 0, misses == 0, ~0s compiling")."""
+    m = _metrics()
+    return {
+        "backend_compiles": m["compiles"].value,
+        "backend_compile_seconds": m["compile_seconds"].sum,
+        "cache_hits": m["hits"].value,
+        "cache_misses": m["misses"].value,
+        "cache_saved_seconds": m["saved_seconds"].value,
+    }

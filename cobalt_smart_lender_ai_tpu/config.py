@@ -138,6 +138,24 @@ class TuneConfig:
     #: lost the 130k-row search to a 1-core CPU oracle on host-sync overhead).
     #: None = single dispatch.
     chunk_trees: int | str | None = None
+    #: Successive-halving scheduler over the chunked dispatch schedule
+    #: (`parallel/tune.py successive_halving_search`): the ``(offset,
+    #: chunk_trees)`` dispatches become rungs, candidates are scored on their
+    #: carried validation margins at each rung boundary (free — the margins
+    #: already exist), and the bottom ``1 - 1/halving_eta`` of candidates are
+    #: pruned (all CV folds of a candidate live or die together). Survivors'
+    #: final scores are exact (identical margins to a full run); only pruned
+    #: candidates' scores are partial-fidelity. Engages only when the search
+    #: actually chunks (chunk_trees yields >= 2 dispatches somewhere) and the
+    #: rung ladder is at least ``halving_min_rungs`` deep; otherwise — and
+    #: always when False — the exhaustive path runs, bit-identical to a
+    #: pre-halving search.
+    halving_enabled: bool = True
+    #: Keep the top ``1/eta`` of live candidates at each rung boundary.
+    halving_eta: int = 2
+    #: Minimum rung-ladder depth (incl. the final full-budget rung) for
+    #: halving to engage; shallower schedules fall back to exhaustive.
+    halving_min_rungs: int = 2
     # Search space: model_tree_train_test.py:139-146
     param_space: Mapping[str, Sequence[Any]] = dataclasses.field(
         default_factory=lambda: {
@@ -386,12 +404,35 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class CompileCacheConfig:
+    """Persistent XLA compile cache (`compilecache.bootstrap_compile_cache`).
+
+    On by default for every framework entrypoint (pipeline, parity, retrain,
+    serve, bench): a warm cache turns the 40-400s remote compile wall of a
+    cold protocol run into a disk read. Opt out per-process with
+    ``COBALT_COMPILE_CACHE=0`` (no config edit needed on shared hosts).
+    """
+
+    enabled: bool = True
+    #: Cache directory; ``None`` -> ``JAX_COMPILATION_CACHE_DIR`` env if set,
+    #: else ``~/.cache/cobalt_smart_lender_ai_tpu/jax_cache``.
+    cache_dir: str | None = None
+    #: Only persist programs that took at least this long to compile. The 5s
+    #: default skips throwaway host-side programs; CI smoke jobs set 0.0 so
+    #: even millisecond CPU compiles round-trip through the cache.
+    min_compile_time_secs: float = 5.0
+
+
+@dataclasses.dataclass(frozen=True)
 class PipelineConfig:
     #: Write the cleaned / tree / nn intermediate frames to the store (the
     #: reference persists every inter-stage CSV to S3). At full-table scale
     #: this fetches the engineered device matrices back to host (~GB); turn
     #: off for pure-throughput runs.
     save_intermediate: bool = True
+    compile_cache: CompileCacheConfig = dataclasses.field(
+        default_factory=CompileCacheConfig
+    )
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     gbdt: GBDTConfig = dataclasses.field(default_factory=GBDTConfig)
     mlp: MLPConfig = dataclasses.field(default_factory=MLPConfig)

@@ -64,7 +64,11 @@ def profile_trace(log_dir: str | None) -> Iterator[None]:
         yield
 
 
-def enable_persistent_compile_cache(cache_dir: str | None = None) -> str | None:
+def enable_persistent_compile_cache(
+    cache_dir: str | None = None,
+    *,
+    min_compile_time_secs: float = 5.0,
+) -> str | None:
     """Opportunistically enable JAX's persistent compilation cache.
 
     Remote compiles over this environment's tunneled backend run 40-400s
@@ -75,7 +79,13 @@ def enable_persistent_compile_cache(cache_dir: str | None = None) -> str | None:
     ``JAX_COMPILATION_CACHE_DIR``; defaults to the user cache dir.
     Opportunistic for real: an unwritable cache directory (read-only HOME
     in a hardened container) degrades to no caching instead of failing the
-    caller. Returns the directory in effect, or None when disabled."""
+    caller. Returns the directory in effect, or None when disabled.
+
+    ``min_compile_time_secs`` gates which programs are persisted; pass 0.0
+    (CI smoke, CPU backends) to cache even millisecond compiles — the entry
+    size floor is dropped alongside so small CPU executables qualify too.
+    Most callers should go through `compilecache.bootstrap_compile_cache`,
+    which layers config/env policy and telemetry on top of this primitive."""
     import logging
     import os
 
@@ -87,7 +97,14 @@ def enable_persistent_compile_cache(cache_dir: str | None = None) -> str | None:
     try:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(min_compile_time_secs),
+        )
+        if float(min_compile_time_secs) <= 0.0:
+            # -1 disables the default "entries must be > N bytes" floor,
+            # which would otherwise silently skip small CPU executables.
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except OSError as e:
         logging.getLogger(__name__).warning(
             "persistent compile cache disabled (%s unwritable: %s)",

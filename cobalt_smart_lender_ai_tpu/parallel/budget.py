@@ -176,6 +176,66 @@ def record_dispatch_walls(
         logging.getLogger(__name__).debug("calibration store skipped: %s", e)
 
 
+def record_first_dispatch_wall(
+    *,
+    n_rows: int,
+    n_feats: int,
+    n_bins: int,
+    depth: int,
+    n_jobs: int,
+    wall_s: float,
+) -> None:
+    """Append a measured FIRST-dispatch wall (compile + one execution,
+    seconds — not a ratio) under ``<shape_key>:first`` in the same store as
+    the steady ratios. Keeping compile walls in their own keys is what keeps
+    the steady samples warm-world: `resolve_chunk_trees` consumes only the
+    ratio keys, so a 300s cold compile can never shrink future chunk sizes,
+    while the ``:first`` history documents what a cold start costs at each
+    shape (and how the persistent compile cache collapses it). Best-effort,
+    like `record_dispatch_walls`."""
+    import json
+    import logging
+    import os
+
+    key = _shape_key(n_rows, n_feats, n_bins, depth, n_jobs) + ":first"
+    path = _calibration_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        data = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+        samples = data.get(key, [])
+        samples.append(round(wall_s, 3))
+        data[key] = samples[-16:]
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+    except (OSError, ValueError) as e:
+        logging.getLogger(__name__).debug("calibration store skipped: %s", e)
+
+
+def first_dispatch_wall(
+    n_rows: int, n_feats: int, n_bins: int, depth: int, n_jobs: int
+) -> float | None:
+    """Median recorded first-dispatch wall for this shape bucket (seconds),
+    or None when never measured."""
+    import json
+    import statistics
+
+    try:
+        with open(_calibration_path()) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    key = _shape_key(n_rows, n_feats, n_bins, depth, n_jobs) + ":first"
+    samples = data.get(key)
+    if not samples:
+        return None
+    return float(statistics.median(samples))
+
+
 class SteadyLoopTimer:
     """One shared timing protocol for every chunked dispatch loop.
 
@@ -188,12 +248,24 @@ class SteadyLoopTimer:
     (a ragged tail still runs the full-size program with inert tree slots),
     so the measurement reflects executed compute, not logical trees.
     Disabled below ``min_dispatches`` (too little signal past the compile).
+
+    The first dispatch's wall (compile + one execution) is ALSO captured —
+    construction timestamps the loop entry, so ``first_done`` brackets it —
+    and `finish` folds it into the calibration store under the shape's
+    ``:first`` key plus the ``cobalt_compile_first_dispatch_seconds``
+    telemetry histogram. Under a warm persistent compile cache the ``:first``
+    samples collapse toward one steady dispatch, which is the direct
+    evidence the cache is working at a given shape.
     """
 
     def __init__(self, n_dispatches: int, min_dispatches: int = 3):
         self.n_dispatches = n_dispatches
         self._enabled = n_dispatches >= min_dispatches
         self._t0 = None
+        self._first_wall = None
+        import time
+
+        self._t_enter = time.time()
 
     def first_done(self, sync) -> None:
         if self._enabled and self._t0 is None:
@@ -201,6 +273,7 @@ class SteadyLoopTimer:
 
             sync()
             self._t0 = time.time()
+            self._first_wall = self._t0 - self._t_enter
 
     def finish(
         self,
@@ -229,6 +302,29 @@ class SteadyLoopTimer:
             wall_s=time.time() - self._t0,
             hist_subtract=hist_subtract,
         )
+        if self._first_wall is not None:
+            record_first_dispatch_wall(
+                n_rows=n_rows,
+                n_feats=n_feats,
+                n_bins=n_bins,
+                depth=depth,
+                n_jobs=n_jobs,
+                wall_s=self._first_wall,
+            )
+            try:
+                from cobalt_smart_lender_ai_tpu.telemetry import (
+                    default_registry,
+                    log_buckets,
+                )
+
+                default_registry().histogram(
+                    "cobalt_compile_first_dispatch_seconds",
+                    "wall of the first (compile-inclusive) dispatch of each "
+                    "chunked loop",
+                    buckets=log_buckets(1e-2, 600.0, per_decade=3),
+                ).observe(self._first_wall)
+            except Exception:  # pragma: no cover - telemetry is best-effort
+                pass
 
 
 def calibration_factor(
@@ -356,5 +452,7 @@ __all__ = [
     "resolve_chunk_trees",
     "auto_steps_per_dispatch",
     "record_dispatch_walls",
+    "record_first_dispatch_wall",
+    "first_dispatch_wall",
     "calibration_factor",
 ]

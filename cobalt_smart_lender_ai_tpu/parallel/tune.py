@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import math
+import time
 from functools import partial
 from typing import Any, Mapping, Sequence
 
@@ -42,8 +43,31 @@ from cobalt_smart_lender_ai_tpu.ops.binning import compute_bin_edges, transform
 from cobalt_smart_lender_ai_tpu.ops.metrics import roc_auc
 from cobalt_smart_lender_ai_tpu.parallel.mesh import make_mesh, pad_rows
 from cobalt_smart_lender_ai_tpu.parallel.sharded import _pad_to, fit_binned_dp
+from cobalt_smart_lender_ai_tpu.telemetry import default_registry, span
 
 logger = logging.getLogger("cobalt_smart_lender_ai_tpu.tune")
+
+
+def _search_metrics():
+    """``cobalt_search_*`` family, resolved at call time so tests that swap
+    the default registry see fresh counters."""
+    reg = default_registry()
+    return {
+        "dispatch_seconds": reg.counter(
+            "cobalt_search_dispatch_seconds",
+            "wall seconds spent dispatching+scoring search fan-out work, by "
+            "scheduler mode",
+            ("mode",),
+        ),
+        "pruned": reg.counter(
+            "cobalt_search_pruned_candidates_total",
+            "candidates pruned at successive-halving rung boundaries",
+        ),
+        "rungs": reg.counter(
+            "cobalt_search_rungs_total",
+            "successive-halving rung boundaries evaluated",
+        ),
+    }
 
 
 def sample_candidates(
@@ -146,6 +170,95 @@ class SearchResult:
     cv_results_: dict[str, Any]
 
 
+def _make_cv_runner(
+    mesh: Mesh,
+    *,
+    k_trees: int,
+    depth_cap: int,
+    n_bins: int,
+    hp_axis: str,
+    dp_axis: str,
+    hist_subtract: bool,
+):
+    """One compiled chunk-advance program for the CV fan-out.
+
+    Each call advances every vmapped (candidate, fold) job by ``k_trees``
+    boosting rounds from a global ``tree_offset``, carrying the per-job
+    margin — the fan-out analog of `fit_binned_chunked`. The carried margin
+    over ALL rows (weight-0 validation rows are routed through every tree
+    too) IS the forest's predict margin, so no separate predict pass is
+    needed and chunking is bit-identical to one dispatch: tree RNG streams
+    and the traced ``n_estimators`` mask both key off the global tree index
+    via ``tree_offset``. Shared by the exhaustive loop
+    (`cross_validate_gbdt`) and the halving scheduler
+    (`successive_halving_search`); the program's structure depends only on
+    ``(k_trees, depth_cap, n_bins, mesh axes)``, so under the persistent
+    compile cache each such shape compiles once ever per machine.
+    """
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(hp_axis, dp_axis),  # carried margins
+            P(),  # global tree offset
+            P(dp_axis, None),  # bins
+            P(dp_axis),  # y
+            P(None, dp_axis),  # val masks
+            P(dp_axis),  # row weights (0 on dp padding)
+            P(hp_axis),  # job hp pytree
+            P(hp_axis),  # job fold ids
+            P(hp_axis),  # job global ids
+            P(None),  # feature mask
+            P(),  # rng
+        ),
+        out_specs=P(hp_axis, dp_axis),
+        check_vma=False,
+    )
+    def _run(m_l, off_l, bins_l, y_l, val_l, w_l, hp_l, fold_l, ids_l, fm_l, rng_l):
+        def one_job(m0, hp_j, fold_j, id_j):
+            train_w = w_l * (1.0 - val_l[fold_j])
+            _, m1 = fit_binned_resumable(
+                bins_l,
+                y_l,
+                train_w,
+                fm_l,
+                hp_j,
+                jax.random.fold_in(rng_l, id_j),
+                n_trees_cap=k_trees,
+                depth_cap=depth_cap,
+                n_bins=n_bins,
+                axis_name=dp_axis,
+                # dp>1 keeps the slower direct histograms so scores stay
+                # bit-identical to a single device (see fit_binned_dp);
+                # the caller can force direct mode on one device too.
+                hist_subtract=hist_subtract,
+                init_margin=m0,
+                tree_offset=off_l,
+            )
+            return m1
+
+        return jax.vmap(one_job)(m_l, hp_l, fold_l, ids_l)  # (J_local, N_local)
+
+    # Donate the carried margins: the caller rebinds them every chunk, so
+    # without donation each dispatch double-buffers the largest tensor in
+    # the loop (~550MB at 60 jobs x 2.3M rows).
+    return jax.jit(_run, donate_argnums=(0,))
+
+
+@jax.jit
+def _score_jobs(margins, val_masks_f, w_f, job_fold, y_f):
+    """Weighted validation ROC-AUC per vmapped job, from carried margins.
+    Module-level jit: the halving scheduler re-scores at every rung and the
+    exhaustive path scores once per bucket; one cache entry per margin shape
+    serves them all."""
+
+    def one(m, fold_j):
+        return roc_auc(y_f, m, weight=val_masks_f[fold_j] * w_f)
+
+    return jax.vmap(one)(margins, job_fold)
+
+
 def cross_validate_gbdt(
     mesh: Mesh,
     bins: jax.Array,  # (N, F) binned training rows
@@ -244,64 +357,9 @@ def cross_validate_gbdt(
     w_p = _pad_to(sw, n_total, 0.0)
 
     # Each dispatch advances every job by one chunk of boosting rounds,
-    # carrying the per-job margin — the fan-out analog of
-    # `fit_binned_chunked` (this environment kills dispatches over ~60s; a
-    # 60-job x 300-tree single dispatch at full-table scale is minutes).
-    # The carried margin over ALL rows (weight-0 validation rows are routed
-    # through every tree too) IS the forest's predict margin, so no separate
-    # predict pass is needed and chunking is bit-identical to one dispatch:
-    # tree RNG streams and the traced `n_estimators` mask both key off the
-    # global tree index via `tree_offset`.
-    def make_runner(k_trees: int):
-        @partial(
-            shard_map,
-            mesh=mesh,
-            in_specs=(
-                P(hp_axis, dp_axis),  # carried margins
-                P(),  # global tree offset
-                P(dp_axis, None),  # bins
-                P(dp_axis),  # y
-                P(None, dp_axis),  # val masks
-                P(dp_axis),  # row weights (0 on dp padding)
-                P(hp_axis),  # job hp pytree
-                P(hp_axis),  # job fold ids
-                P(hp_axis),  # job global ids
-                P(None),  # feature mask
-                P(),  # rng
-            ),
-            out_specs=P(hp_axis, dp_axis),
-            check_vma=False,
-        )
-        def _run(m_l, off_l, bins_l, y_l, val_l, w_l, hp_l, fold_l, ids_l, fm_l, rng_l):
-            def one_job(m0, hp_j, fold_j, id_j):
-                train_w = w_l * (1.0 - val_l[fold_j])
-                _, m1 = fit_binned_resumable(
-                    bins_l,
-                    y_l,
-                    train_w,
-                    fm_l,
-                    hp_j,
-                    jax.random.fold_in(rng_l, id_j),
-                    n_trees_cap=k_trees,
-                    depth_cap=depth_cap,
-                    n_bins=n_bins,
-                    axis_name=dp_axis,
-                    init_margin=m0,
-                    tree_offset=off_l,
-                    # dp>1 keeps the slower direct histograms so scores stay
-                    # bit-identical to a single device (see fit_binned_dp);
-                    # the caller can force direct mode on one device too.
-                    hist_subtract=hist_subtract,
-                )
-                return m1
-
-            return jax.vmap(one_job)(m_l, hp_l, fold_l, ids_l)  # (J_local, N_local)
-
-        # Donate the carried margins: the caller rebinds them every chunk, so
-        # without donation each dispatch double-buffers the largest tensor in
-        # the loop (~550MB at 60 jobs x 2.3M rows).
-        return jax.jit(_run, donate_argnums=(0,))
-
+    # carrying the per-job margin (`_make_cv_runner`; this environment kills
+    # dispatches over ~60s — a 60-job x 300-tree single dispatch at
+    # full-table scale is minutes).
     if chunk_trees is None or chunk_trees >= n_trees_cap:
         schedule = [(0, n_trees_cap)]
     else:
@@ -323,8 +381,17 @@ def cross_validate_gbdt(
         n_jobs, n_trees_cap, depth_cap, n_bins, N,
         chunk_trees, len(schedule),
     )
-    runner = make_runner(schedule[0][1])
+    runner = _make_cv_runner(
+        mesh,
+        k_trees=schedule[0][1],
+        depth_cap=depth_cap,
+        n_bins=n_bins,
+        hp_axis=hp_axis,
+        dp_axis=dp_axis,
+        hist_subtract=hist_subtract,
+    )
     margins = jnp.zeros((n_jobs_padded, n_total), jnp.float32)
+    t_loop = time.time()
     # Coarse progress logs (with a blocking sync every ~quarter of the
     # schedule): a multi-minute silent dispatch loop is undebuggable when a
     # backend RPC wedges — the last line printed brackets the hang.
@@ -376,14 +443,7 @@ def cross_validate_gbdt(
                 i + 1, len(schedule), off, off + _k_trees,
             )
 
-    @jax.jit
-    def _score(margins, val_masks_f, w_f, job_fold, y_f):
-        def one(m, fold_j):
-            return roc_auc(y_f, m, weight=val_masks_f[fold_j] * w_f)
-
-        return jax.vmap(one)(margins, job_fold)
-
-    # Timer stops BEFORE _score (a separate program whose first compile
+    # Timer stops BEFORE scoring (a separate program whose first compile
     # would otherwise pollute the measurement).
     timer.finish(
         lambda: np.asarray(margins[:1, :1]),
@@ -395,8 +455,407 @@ def cross_validate_gbdt(
         n_jobs=n_jobs_padded // hp_size,
         hist_subtract=hist_subtract,
     )
-    aucs = _score(margins, val_p, w_p, job_fold, y_p.astype(jnp.float32))
+    # Scalar sync bounds the dispatch wall honestly (the loop above only
+    # enqueues); same counter the halving scheduler feeds, so bench/CI can
+    # compare tree-dispatch seconds across scheduler modes.
+    np.asarray(margins[:1, :1])
+    _search_metrics()["dispatch_seconds"].labels(mode="exhaustive").inc(
+        time.time() - t_loop
+    )
+    aucs = _score_jobs(margins, val_p, w_p, job_fold, y_p.astype(jnp.float32))
     return aucs[:n_jobs].reshape(C, K)
+
+
+def _pow2_jobs(n_jobs: int, hp_size: int) -> int:
+    """Job-axis padding for the halving scheduler: the next power of two at
+    or above ``n_jobs``, floored at (and padded to a multiple of) the hp mesh
+    axis. A fixed geometric ladder instead of exact padding means survivor
+    repacks revisit the SAME shapes — at most log2(J) distinct programs per
+    (chunk, depth) runner, each compiled once ever under the persistent
+    compile cache — where exact padding would compile a fresh program for
+    every distinct survivor count."""
+    p = 1
+    while p < max(n_jobs, 1):
+        p <<= 1
+    p = max(p, hp_size)
+    return p + (-p) % hp_size
+
+
+def _ilog(n: int, eta: int) -> int:
+    """floor(log_eta(n)) without float-precision edge cases."""
+    r, v = 0, 1
+    while v * eta <= n:
+        v *= eta
+        r += 1
+    return r
+
+
+def halving_ladder(
+    n_trees_cap: int, n_candidates: int, *, eta: int, min_rungs: int
+) -> list[int] | None:
+    """Geometric rung budgets (ascending tree counts, final == cap) for a
+    successive-halving run, or None when the run is too small to halve.
+
+    Rung count is bounded both by the tree budget (eta-spaced budgets below
+    ``n_trees_cap``) and by the candidate count (after floor(log_eta(C))
+    prunings ~1 candidate remains; more rungs would just re-score a fixed
+    survivor set). Returns None — caller falls back to exhaustive — when
+    fewer than ``min_rungs`` (>= 2) rungs result."""
+    eta = max(2, int(eta))
+    if n_candidates < 2 or n_trees_cap < 2:
+        return None
+    n_rungs = min(_ilog(n_candidates, eta) + 1, _ilog(n_trees_cap, eta) + 1)
+    if n_rungs < max(2, int(min_rungs)):
+        return None
+    budgets: list[int] = []
+    for j in range(n_rungs):
+        b = -(-n_trees_cap // eta ** (n_rungs - 1 - j))
+        if not budgets or b > budgets[-1]:
+            budgets.append(int(b))
+    if len(budgets) < max(2, int(min_rungs)):
+        return None
+    return budgets
+
+
+class _HalvingContext:
+    """Row-side tensors shared by every halving bucket: built once per
+    search, identical construction to `cross_validate_gbdt`'s."""
+
+    def __init__(
+        self, mesh, bins, y, val_masks, *, feature_mask, sample_weight,
+        n_bins, hp_axis, dp_axis, hist_subtract, rng,
+    ):
+        self.mesh = mesh
+        self.hp_axis, self.dp_axis = hp_axis, dp_axis
+        self.hp_size = mesh.shape[hp_axis]
+        self.dp_size = mesh.shape[dp_axis]
+        self.n_bins = n_bins
+        self.hist_subtract = hist_subtract and self.dp_size == 1
+        self.rng = rng
+        K, N = val_masks.shape
+        self.K, self.N = K, N
+        self.F = bins.shape[1]
+        self.fm = (
+            jnp.ones((self.F,), bool) if feature_mask is None else feature_mask
+        )
+        sw = (
+            jnp.ones((N,), jnp.float32)
+            if sample_weight is None
+            else sample_weight.astype(jnp.float32)
+        )
+        self.n_total = N + pad_rows(N, self.dp_size)
+        self.bins_p = _pad_to(bins, self.n_total, 0)
+        self.y_p = _pad_to(y, self.n_total, 0)
+        self.val_p = _pad_to(
+            val_masks.astype(jnp.float32).T, self.n_total, 0.0
+        ).T  # (K, n_total)
+        self.w_p = _pad_to(sw, self.n_total, 0.0)
+        self.y_f = self.y_p.astype(jnp.float32)
+        self.dispatches = 0
+
+
+class _HalvingBucket:
+    """Live state of one (depth, n_estimators) candidate group across rungs.
+
+    The group shares its depth's chunk-advance runner (`_make_cv_runner`):
+    the runner's program depends only on (chunk, depth), so every bucket of
+    a depth reuses it, and within a bucket the only shape that varies across
+    rungs is the pow2-laddered job axis (`_pow2_jobs`). Margins are carried
+    between rungs; pruning row-selects the survivors' margins, so no
+    boosting work is ever repeated."""
+
+    def __init__(self, ctx, cand_idxs, candidates, base, chunk, runner):
+        self.ctx = ctx
+        self.candidates = candidates
+        self.base = base
+        cfgs = [base.replace(**dict(candidates[i])) for i in cand_idxs]
+        self.cap = max(c.n_estimators for c in cfgs)
+        self.depth = max(c.max_depth for c in cfgs)
+        self.chunk = int(chunk)
+        self.runner = runner
+        self.trees_done = 0
+        self.live: list[int] = list(cand_idxs)
+        self._margins = None
+        self._pack(self.live)
+
+    def _pack(self, live: list[int], prev_pos: dict[int, int] | None = None):
+        ctx = self.ctx
+        K = ctx.K
+        hps, _, _ = stack_candidates(
+            [self.candidates[i] for i in live], self.base
+        )
+        n_jobs = len(live) * K
+        padded = _pow2_jobs(n_jobs, ctx.hp_size)
+        job_hp = jax.tree.map(lambda a: jnp.repeat(a, K, axis=0), hps)
+        self._job_hp = jax.tree.map(lambda a: _pad_to(a, padded, 0), job_hp)
+        self._job_fold = _pad_to(
+            jnp.tile(jnp.arange(K, dtype=jnp.int32), len(live)), padded, 0
+        )
+        # Global candidate ids keep each job's RNG stream — and therefore
+        # its margins — identical across repacks and to the joint dispatch.
+        job_ids = jnp.repeat(jnp.asarray(live, jnp.int32), K) * K + jnp.tile(
+            jnp.arange(K, dtype=jnp.int32), len(live)
+        )
+        self._job_ids = _pad_to(job_ids, padded, 0)
+        if prev_pos is None:
+            self._margins = jnp.zeros((padded, ctx.n_total), jnp.float32)
+        else:
+            rows = np.concatenate(
+                [np.arange(prev_pos[i] * K, prev_pos[i] * K + K) for i in live]
+            )
+            kept = jnp.take(self._margins, jnp.asarray(rows), axis=0)
+            self._margins = _pad_to(kept, padded, 0.0)
+        self.live = list(live)
+        self._n_jobs = n_jobs
+        self._padded = padded
+
+    def live_cap(self) -> int:
+        return max(
+            self.base.replace(**dict(self.candidates[i])).n_estimators
+            for i in self.live
+        )
+
+    def advance(self, budget_trees: int) -> None:
+        """Boost every live job up to ``min(budget, live cap)`` global trees
+        in full-chunk dispatches (overflow trees are inert — the tail-padding
+        design of the exhaustive schedule — so one program serves the ragged
+        last chunk too)."""
+        from cobalt_smart_lender_ai_tpu.debug import retry_first_dispatch
+
+        ctx = self.ctx
+        target = min(budget_trees, self.live_cap())
+        while self.trees_done < target:
+            off = self.trees_done
+
+            def _dispatch():
+                return self.runner(
+                    self._margins,
+                    jnp.int32(off),
+                    ctx.bins_p,
+                    ctx.y_p,
+                    ctx.val_p,
+                    ctx.w_p,
+                    self._job_hp,
+                    self._job_fold,
+                    self._job_ids,
+                    ctx.fm,
+                    ctx.rng,
+                )
+
+            def _rebuild():
+                self._margins = jnp.zeros(
+                    (self._padded, ctx.n_total), jnp.float32
+                )
+
+            # Only the very first dispatch starts from rebuildable zeros;
+            # later chunks carry real margins (same policy as the
+            # exhaustive loop).
+            self._margins = retry_first_dispatch(
+                _dispatch, _rebuild, is_first=self.trees_done == 0
+            )
+            self.trees_done += self.chunk
+            ctx.dispatches += 1
+
+    def scores(self) -> np.ndarray:
+        """(len(live), K) validation AUCs from the carried margins — free in
+        tree-work terms: the margins already exist, only the O(N log N)
+        scoring program runs. Syncs (np.asarray) to bound the async queue."""
+        ctx = self.ctx
+        aucs = _score_jobs(
+            self._margins, ctx.val_p, ctx.w_p, self._job_fold, ctx.y_f
+        )
+        return np.asarray(aucs[: self._n_jobs]).reshape(len(self.live), ctx.K)
+
+    def prune(self, keep: set[int]) -> None:
+        new_live = [i for i in self.live if i in keep]
+        if len(new_live) == len(self.live):
+            return
+        if not new_live:
+            self.live = []
+            self._margins = None
+            return
+        prev_pos = {cid: pos for pos, cid in enumerate(self.live)}
+        self._pack(new_live, prev_pos=prev_pos)
+
+
+def successive_halving_search(
+    mesh: Mesh,
+    bins: jax.Array,
+    y: jax.Array,
+    candidates: Sequence[Mapping[str, Any]],
+    base: GBDTConfig,
+    tune: TuneConfig,
+    val_masks: jax.Array,
+    rng: jax.Array,
+    *,
+    feature_mask: jax.Array | None = None,
+    sample_weight: jax.Array | None = None,
+    hp_axis: str = "hp",
+    dp_axis: str = "dp",
+) -> tuple[np.ndarray, dict[str, Any]] | None:
+    """Successive-halving CV over the chunked dispatch schedule.
+
+    The exhaustive fan-out boosts all C x K jobs to their full
+    ``n_estimators`` even when a candidate is hopeless by tree 32. Here the
+    ``(offset, chunk_trees)`` dispatch schedule becomes rungs: at each
+    geometric tree budget (`halving_ladder`) every live candidate's
+    validation AUC is evaluated on its carried margins (free — no extra
+    boosting), the bottom ``1 - 1/eta`` of candidates are pruned (all CV
+    folds of a candidate live or die together; ties break on the lower
+    candidate id, deterministically), and survivors are repacked onto a
+    pow2-laddered job axis (`_pow2_jobs`). Survivors reaching the final
+    rung carry exactly the margins a full run would have produced, so their
+    scores are exact; only pruned candidates' scores are partial-fidelity.
+
+    Returns ``(split_scores (C, K), report)`` — pruned candidates hold the
+    scores from their last rung — or **None when halving cannot help**: the
+    schedule never chunks (every bucket is a single dispatch, so there is
+    nothing to stop early), the rung ladder is shallower than
+    ``tune.halving_min_rungs``, or fewer than two candidates exist. Callers
+    fall back to the exhaustive path, which keeps every small/legacy search
+    bit-identical to pre-halving behavior.
+    """
+    from cobalt_smart_lender_ai_tpu.parallel.budget import resolve_chunk_trees
+
+    C = len(candidates)
+    cfgs = [base.replace(**dict(c)) for c in candidates]
+    global_cap = max(c.n_estimators for c in cfgs)
+    eta = max(2, int(tune.halving_eta))
+    budgets = halving_ladder(
+        global_cap, C, eta=eta, min_rungs=tune.halving_min_rungs
+    )
+    if budgets is None:
+        return None
+    K, N = val_masks.shape
+    F = bins.shape[1]
+    hp_size = mesh.shape[hp_axis]
+    dp_size = mesh.shape[dp_axis]
+    hist_subtract = base.hist_subtract and dp_size == 1
+
+    # One chunk size + runner per depth, shared by that depth's
+    # (depth, n_est) buckets: the runner program depends only on
+    # (chunk, depth), so sharing maximizes compile reuse while per-n_est
+    # buckets still stop boosting at their own caps. Chunks are resolved
+    # against the depth's LARGEST bucket (budget-safe for the smaller ones)
+    # — all host-side math, nothing dispatched yet.
+    groups = search_buckets(candidates, base)
+    by_depth: dict[int, list[list[int]]] = {}
+    for idxs in groups:
+        by_depth.setdefault(cfgs[idxs[0]].max_depth, []).append(idxs)
+    chunk_of: dict[int, int] = {}
+    any_chunked = False
+    for d, subs in by_depth.items():
+        cap_d = max(cfgs[i].n_estimators for idxs in subs for i in idxs)
+        jobs_d = max(_pow2_jobs(len(idxs) * K, hp_size) for idxs in subs)
+        ck = tune.chunk_trees
+        if ck is not None:
+            ck = resolve_chunk_trees(
+                ck,
+                n_trees=cap_d,
+                n_rows=-(-N // dp_size),
+                n_feats=F,
+                n_bins=base.n_bins,
+                depth=d,
+                n_jobs=jobs_d // hp_size,
+                hist_subtract=hist_subtract,
+            )
+        chunk_of[d] = cap_d if ck is None else min(int(ck), cap_d)
+        if chunk_of[d] < cap_d:
+            any_chunked = True
+    if not any_chunked:
+        return None
+
+    ctx = _HalvingContext(
+        mesh, bins, y, val_masks,
+        feature_mask=feature_mask, sample_weight=sample_weight,
+        n_bins=base.n_bins, hp_axis=hp_axis, dp_axis=dp_axis,
+        hist_subtract=hist_subtract, rng=rng,
+    )
+    runners = {
+        d: _make_cv_runner(
+            mesh,
+            k_trees=chunk_of[d],
+            depth_cap=d,
+            n_bins=base.n_bins,
+            hp_axis=hp_axis,
+            dp_axis=dp_axis,
+            hist_subtract=ctx.hist_subtract,
+        )
+        for d in by_depth
+    }
+    buckets = [
+        _HalvingBucket(ctx, idxs, candidates, base, chunk_of[d], runners[d])
+        for d, subs in sorted(by_depth.items())
+        for idxs in subs
+    ]
+    logger.info(
+        "halving search: %d candidates x %d folds, rung budgets %s "
+        "(eta=%d), %d depth runner(s)",
+        C, K, budgets, eta, len(by_depth),
+    )
+
+    metrics = _search_metrics()
+    split_scores = np.zeros((C, K))
+    scored_at = np.zeros(C, dtype=np.int64)
+    rung_report: list[dict[str, Any]] = []
+    pruned_total = 0
+    for ri, budget_trees in enumerate(budgets):
+        t0 = time.time()
+        with span(
+            "search.rung",
+            rung=ri,
+            budget_trees=budget_trees,
+            live=sum(len(b.live) for b in buckets),
+        ):
+            for b in buckets:
+                b.advance(budget_trees)
+            cand_mean: dict[int, float] = {}
+            for b in buckets:
+                sc = b.scores()
+                for pos, cid in enumerate(b.live):
+                    split_scores[cid] = sc[pos]
+                    scored_at[cid] = min(budget_trees, cfgs[cid].n_estimators)
+                    cand_mean[cid] = float(sc[pos].mean())
+        metrics["dispatch_seconds"].labels(mode="halving").inc(
+            time.time() - t0
+        )
+        metrics["rungs"].inc()
+        n_live = len(cand_mean)
+        if ri == len(budgets) - 1:
+            rung_report.append(
+                {"rung": ri, "budget_trees": budget_trees,
+                 "live": n_live, "pruned": 0}
+            )
+            break
+        n_keep = max(1, -(-n_live // eta))
+        order = sorted(cand_mean, key=lambda cid: (-cand_mean[cid], cid))
+        keep = set(order[:n_keep])
+        pruned = n_live - n_keep
+        pruned_total += pruned
+        metrics["pruned"].inc(pruned)
+        rung_report.append(
+            {"rung": ri, "budget_trees": budget_trees,
+             "live": n_live, "pruned": pruned}
+        )
+        logger.info(
+            "halving rung %d/%d @ %d trees: %d live -> %d kept",
+            ri + 1, len(budgets), budget_trees, n_live, n_keep,
+        )
+        for b in buckets:
+            b.prune(keep)
+        buckets = [b for b in buckets if b.live]
+
+    survivors = sorted(i for b in buckets for i in b.live)
+    report = {
+        "eta": eta,
+        "budgets": budgets,
+        "rungs": rung_report,
+        "pruned_candidates": pruned_total,
+        "survivors": survivors,
+        "scored_at_trees": scored_at.tolist(),
+        "dispatches": ctx.dispatches,
+    }
+    return split_scores, report
 
 
 def randomized_search(
@@ -424,45 +883,77 @@ def randomized_search(
     )
     fm = None if feature_mask is None else jnp.asarray(feature_mask, bool)
 
-    # Per-bucket dispatches keep each job's tree tensor at its own depth and
-    # its boosting rounds at its own n_estimators (see `search_buckets` for
-    # why scores are invariant to the grouping).
-    split_scores = np.zeros((len(candidates), tune.cv_folds))
-    for idxs in search_buckets(candidates, base):
-        hps, n_trees_cap, depth_cap = stack_candidates(
-            [candidates[i] for i in idxs], base
-        )
-        aucs = cross_validate_gbdt(
+    # Successive halving when it can actually help (chunked schedule, deep
+    # enough ladder — see `successive_halving_search` for the engage rules);
+    # otherwise the exhaustive per-bucket fan-out, bit-identical to the
+    # pre-halving search.
+    halving = None
+    if tune.halving_enabled:
+        halving = successive_halving_search(
             mesh,
             bins,
             jnp.asarray(y_np),
-            hps,
+            candidates,
+            base,
+            tune,
             val_masks,
             jax.random.PRNGKey(tune.seed),
-            n_trees_cap=n_trees_cap,
-            depth_cap=depth_cap,
-            n_bins=base.n_bins,
             feature_mask=fm,
-            cand_ids=jnp.asarray(idxs, jnp.int32),
-            chunk_trees=tune.chunk_trees,
-            hist_subtract=base.hist_subtract,
         )
-        split_scores[idxs] = np.asarray(aucs)
-    mean_auc = split_scores.mean(axis=1)
-    best_i = int(mean_auc.argmax())
+    if halving is not None:
+        split_scores, halving_report = halving
+        mean_auc = split_scores.mean(axis=1)
+        # The winner comes from the final-rung survivors: their margins —
+        # and therefore their scores — are exactly what a full run would
+        # have produced. Pruned candidates carry partial-fidelity scores,
+        # so they never outrank a survivor even if a partial score is
+        # higher. Deterministic candidate-id tie-break, as everywhere.
+        best_i = min(
+            halving_report["survivors"], key=lambda i: (-mean_auc[i], i)
+        )
+    else:
+        # Per-bucket dispatches keep each job's tree tensor at its own depth
+        # and its boosting rounds at its own n_estimators (see
+        # `search_buckets` for why scores are invariant to the grouping).
+        split_scores = np.zeros((len(candidates), tune.cv_folds))
+        for idxs in search_buckets(candidates, base):
+            hps, n_trees_cap, depth_cap = stack_candidates(
+                [candidates[i] for i in idxs], base
+            )
+            aucs = cross_validate_gbdt(
+                mesh,
+                bins,
+                jnp.asarray(y_np),
+                hps,
+                val_masks,
+                jax.random.PRNGKey(tune.seed),
+                n_trees_cap=n_trees_cap,
+                depth_cap=depth_cap,
+                n_bins=base.n_bins,
+                feature_mask=fm,
+                cand_ids=jnp.asarray(idxs, jnp.int32),
+                chunk_trees=tune.chunk_trees,
+                hist_subtract=base.hist_subtract,
+            )
+            split_scores[idxs] = np.asarray(aucs)
+        mean_auc = split_scores.mean(axis=1)
+        best_i = int(mean_auc.argmax())
     best_params = dict(candidates[best_i])
 
     est = GBDTClassifier(base.replace(**best_params))
     est.fit(X, y_np, feature_mask=feature_mask)
+    cv_results = {
+        "params": candidates,
+        "mean_test_score": mean_auc,
+        "split_test_scores": split_scores,
+    }
+    if halving is not None:
+        cv_results["halving"] = halving_report
     return SearchResult(
         best_params_=best_params,
         best_score_=float(mean_auc[best_i]),
         best_estimator_=est,
-        cv_results_={
-            "params": candidates,
-            "mean_test_score": mean_auc,
-            "split_test_scores": split_scores,
-        },
+        cv_results_=cv_results,
     )
 
 
@@ -471,7 +962,9 @@ __all__ = [
     "stack_candidates",
     "stratified_kfold_masks",
     "search_buckets",
+    "halving_ladder",
     "cross_validate_gbdt",
+    "successive_halving_search",
     "randomized_search",
     "SearchResult",
     "fit_binned_dp",

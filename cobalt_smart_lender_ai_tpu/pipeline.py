@@ -48,6 +48,7 @@ from cobalt_smart_lender_ai_tpu.config import (
     RFEConfig,
     TuneConfig,
 )
+from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
 from cobalt_smart_lender_ai_tpu.data import schema
 from cobalt_smart_lender_ai_tpu.data.clean import clean_raw_frame
 from cobalt_smart_lender_ai_tpu.data.features import (
@@ -157,6 +158,11 @@ def _run_pipeline(
     resume: bool | None,
 ) -> PipelineResult:
     cfg = config or PipelineConfig()
+    # Framework default, not a bench-only opt-in: every pipeline run shares
+    # the persistent compile cache (COBALT_COMPILE_CACHE=0 to opt out) and
+    # feeds the cobalt_compile_* telemetry. Idempotent — an entrypoint that
+    # already bootstrapped with its own config wins.
+    bootstrap_compile_cache(cfg.compile_cache)
     rel = cfg.reliability
     resume = rel.resume if resume is None else resume
     timings: dict[str, float] = {}
@@ -469,6 +475,12 @@ def main(argv=None) -> PipelineResult:
         "runs; quality lands in the same AUC regime",
     )
     parser.add_argument(
+        "--no-halving",
+        action="store_true",
+        help="exhaustive hyper-parameter search (every candidate trained to "
+        "its full n_estimators) instead of the successive-halving scheduler",
+    )
+    parser.add_argument(
         "--trace-out",
         default=None,
         help="write the run's stage spans as Chrome Trace Event / Perfetto "
@@ -479,9 +491,7 @@ def main(argv=None) -> PipelineResult:
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s [%(levelname)s] %(message)s"
     )
-    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
-
-    enable_persistent_compile_cache()
+    bootstrap_compile_cache()
     cfg = PipelineConfig()
     if args.quick:
         cfg = dataclasses.replace(
@@ -498,6 +508,10 @@ def main(argv=None) -> PipelineResult:
                     "subsample": (0.8,),
                 },
             ),
+        )
+    if args.no_halving:
+        cfg = dataclasses.replace(
+            cfg, tune=dataclasses.replace(cfg.tune, halving_enabled=False)
         )
     raw = None
     if args.synthetic_rows:

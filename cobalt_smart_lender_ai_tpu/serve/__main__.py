@@ -115,13 +115,12 @@ def main() -> None:
     args = parser.parse_args()
 
     # Scorer-bucket compiles persist across service restarts (tens of
-    # seconds each on a cold backend; the cache makes a restart warm).
-    from cobalt_smart_lender_ai_tpu.debug import (
-        enable_persistent_compile_cache,
-        profile_trace,
-    )
+    # seconds each on a cold backend; the cache makes a restart warm), and
+    # the cobalt_compile_* families land on this process's /metrics.
+    from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
+    from cobalt_smart_lender_ai_tpu.debug import profile_trace
 
-    enable_persistent_compile_cache()
+    bootstrap_compile_cache()
     cfg = ServeConfig(
         host=args.host,
         port=args.port,

@@ -1,0 +1,336 @@
+"""Successive-halving search scheduler + persistent compile cache bootstrap.
+
+Covers PR-10's tentpole invariants: halving prunes but picks the same winner
+as the exhaustive fan-out (survivor scores bit-identical), exhaustive mode
+stays the default fallback whenever the schedule doesn't chunk, the halving
+knobs invalidate the search-stage checkpoint fingerprint both ways, and the
+shared `bootstrap_compile_cache` helper honours its config/env policy.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification
+
+import cobalt_smart_lender_ai_tpu.compilecache as compilecache
+from cobalt_smart_lender_ai_tpu.compilecache import (
+    bootstrap_compile_cache,
+    compile_stats,
+    install_compile_telemetry,
+)
+from cobalt_smart_lender_ai_tpu.config import (
+    CompileCacheConfig,
+    GBDTConfig,
+    MeshConfig,
+    TuneConfig,
+)
+from cobalt_smart_lender_ai_tpu.parallel import make_mesh, randomized_search
+from cobalt_smart_lender_ai_tpu.parallel.tune import (
+    halving_ladder,
+    sample_candidates,
+)
+from cobalt_smart_lender_ai_tpu.reliability import config_fingerprint
+
+# --- sample_candidates: the sampling model feeding both schedulers ----------
+
+GRID_SMALL = {"a": (1, 2, 3), "b": (10, 20)}  # 6 combos -> dense branch
+GRID_BIG = {
+    "a": tuple(range(8)),
+    "b": tuple(range(8)),
+    "c": (0.1, 0.2, 0.3, 0.4),
+}  # 256 combos -> rejection branch
+
+
+def _assert_in_grid(cands, space):
+    for c in cands:
+        assert set(c) == set(space)
+        for k, v in c.items():
+            assert v in space[k], (k, v)
+
+
+@pytest.mark.parametrize(
+    "space,n_iter",
+    [(GRID_SMALL, 5), (GRID_BIG, 16)],
+    ids=["dense-permutation", "rejection-sample"],
+)
+def test_sample_candidates_distinct_in_grid_seed_stable(space, n_iter):
+    cands = sample_candidates(space, n_iter, seed=7)
+    assert len(cands) == n_iter
+    _assert_in_grid(cands, space)
+    # without replacement while the grid can supply distinct combos
+    keys = sorted(space)
+    assert len({tuple(c[k] for k in keys) for c in cands}) == n_iter
+    # seed-stable draw; a different seed moves it
+    assert cands == sample_candidates(space, n_iter, seed=7)
+    assert cands != sample_candidates(space, n_iter, seed=8)
+
+
+def test_sample_candidates_full_grid_is_exact_enumeration():
+    cands = sample_candidates(GRID_SMALL, 6, seed=0)
+    combos = {(c["a"], c["b"]) for c in cands}
+    assert combos == {(a, b) for a in (1, 2, 3) for b in (10, 20)}
+
+
+def test_sample_candidates_overdraw_falls_back_with_replacement():
+    cands = sample_candidates(GRID_SMALL, 10, seed=3)
+    assert len(cands) == 10  # n_iter > total: duplicates, not truncation
+    _assert_in_grid(cands, GRID_SMALL)
+    assert len({(c["a"], c["b"]) for c in cands}) < 10  # pigeonhole
+    assert cands == sample_candidates(GRID_SMALL, 10, seed=3)
+
+
+# --- halving_ladder ----------------------------------------------------------
+
+
+def test_halving_ladder_reference_grid_shape():
+    # 20 candidates x 300-tree cap, eta 2: the PR-10 reference schedule.
+    assert halving_ladder(300, 20, eta=2, min_rungs=2) == [19, 38, 75, 150, 300]
+
+
+def test_halving_ladder_eta3():
+    assert halving_ladder(27, 9, eta=3, min_rungs=2) == [3, 9, 27]
+
+
+@pytest.mark.parametrize("cap,cands", [(40, 1), (1, 8), (300, 0)])
+def test_halving_ladder_degenerate_returns_none(cap, cands):
+    assert halving_ladder(cap, cands, eta=2, min_rungs=2) is None
+
+
+def test_halving_ladder_min_rungs_gate():
+    # 2 candidates support exactly 2 rungs; demanding 3 falls back.
+    assert halving_ladder(100, 2, eta=2, min_rungs=2) == [50, 100]
+    assert halving_ladder(100, 2, eta=2, min_rungs=3) is None
+
+
+@pytest.mark.parametrize("cap", [7, 48, 300])
+@pytest.mark.parametrize("cands", [2, 6, 20])
+def test_halving_ladder_ascending_and_capped(cap, cands):
+    budgets = halving_ladder(cap, cands, eta=2, min_rungs=2)
+    assert budgets is not None
+    assert budgets[-1] == cap
+    assert all(b2 > b1 for b1, b2 in zip(budgets, budgets[1:]))
+
+
+# --- halving search vs exhaustive -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def search_xy():
+    X, y = make_classification(
+        n_samples=1201, n_features=10, n_informative=5, random_state=1
+    )
+    return X.astype(np.float32), y
+
+
+def _run_search(search_xy, *, halving, chunk_trees=12):
+    X, y = search_xy
+    tune = TuneConfig(
+        n_iter=6,
+        cv_folds=2,
+        seed=3,
+        chunk_trees=chunk_trees,
+        halving_enabled=halving,
+        param_space={
+            "n_estimators": (24, 48),
+            "max_depth": (2, 3),
+            "learning_rate": (0.1, 0.3),
+        },
+    )
+    return randomized_search(
+        X, y, GBDTConfig(n_bins=32), tune, make_mesh(MeshConfig(hp=2))
+    )
+
+
+def test_halving_prunes_and_matches_exhaustive_winner(search_xy):
+    ex = _run_search(search_xy, halving=False)
+    hv = _run_search(search_xy, halving=True)
+    assert "halving" not in ex.cv_results_
+    report = hv.cv_results_["halving"]
+    assert report["pruned_candidates"] > 0
+    assert report["budgets"][-1] == 48
+    assert len(report["budgets"]) >= 2
+    # winner comes from the final-rung survivor set, and agrees with the
+    # exhaustive fan-out on the same candidates/folds/seed
+    assert hv.best_params_ == ex.best_params_
+    assert hv.best_score_ == ex.best_score_
+    # survivors boosted to the full budget carry margins bit-identical to a
+    # full run, so their per-split scores match the exhaustive run exactly
+    surv = report["survivors"]
+    np.testing.assert_array_equal(
+        hv.cv_results_["split_test_scores"][surv],
+        ex.cv_results_["split_test_scores"][surv],
+    )
+    # pruned candidates keep partial-fidelity scores; they must never outrank
+    # the winner
+    assert hv.best_score_ == max(hv.cv_results_["mean_test_score"][surv])
+
+
+def test_halving_unchunked_schedule_falls_back_exhaustive(search_xy):
+    # chunk_trees=None -> a single dispatch per bucket: nothing to halve, so
+    # enabling halving must leave the run bit-identical to exhaustive.
+    ex = _run_search(search_xy, halving=False, chunk_trees=None)
+    hv = _run_search(search_xy, halving=True, chunk_trees=None)
+    assert "halving" not in hv.cv_results_
+    assert hv.best_params_ == ex.best_params_
+    np.testing.assert_array_equal(
+        hv.cv_results_["split_test_scores"],
+        ex.cv_results_["split_test_scores"],
+    )
+
+
+# --- checkpoint fingerprint invalidation (satellite 3) -----------------------
+
+
+def test_search_fingerprint_tracks_halving_knobs():
+    base = TuneConfig()
+    fps = {
+        config_fingerprint("search", cfg)
+        for cfg in (
+            base,
+            dataclasses.replace(base, halving_enabled=False),
+            dataclasses.replace(base, halving_eta=3),
+            dataclasses.replace(base, halving_min_rungs=3),
+        )
+    }
+    assert len(fps) == 4  # each knob flips the search-stage fingerprint
+
+
+def test_resume_reruns_search_when_halving_flipped(tmp_path):
+    """An exhaustive search checkpoint must not satisfy a halving-enabled
+    resume, and vice versa — partial-fidelity cv scores are not
+    interchangeable with exhaustive ones."""
+    from cobalt_smart_lender_ai_tpu.config import (
+        PipelineConfig,
+        RFEConfig,
+        ReliabilityConfig,
+    )
+    from cobalt_smart_lender_ai_tpu.data.synthetic import (
+        synthetic_lendingclub_frame,
+    )
+    from cobalt_smart_lender_ai_tpu.io import ObjectStore
+    from cobalt_smart_lender_ai_tpu.pipeline import run_pipeline
+
+    cfg = PipelineConfig(
+        gbdt=GBDTConfig(n_bins=32),
+        rfe=RFEConfig(n_select=10, step=40, n_estimators=8, max_depth=3),
+        tune=TuneConfig(
+            n_iter=2,
+            cv_folds=2,
+            halving_enabled=True,
+            param_space={
+                "n_estimators": (40,),
+                "max_depth": (3,),
+                "learning_rate": (0.1,),
+            },
+        ),
+        mesh=MeshConfig(hp=1),
+        reliability=ReliabilityConfig(
+            base_delay_s=0.0, max_delay_s=0.0, jitter=0.0
+        ),
+    )
+    raw = synthetic_lendingclub_frame(2000, seed=11)
+    store = ObjectStore(str(tmp_path / "lake"))
+    run_pipeline(cfg, raw=raw, store=store)
+
+    def flip(c, enabled):
+        return dataclasses.replace(
+            c, tune=dataclasses.replace(c.tune, halving_enabled=enabled)
+        )
+
+    # halving -> exhaustive: search re-runs, earlier stages stay skipped
+    second = run_pipeline(flip(cfg, False), store=store, resume=True)
+    assert "search" in second.stages_run
+    assert {"clean", "engineer", "rfe"} <= set(second.stages_skipped)
+    # exhaustive -> halving: the exhaustive checkpoint doesn't satisfy either
+    third = run_pipeline(flip(cfg, True), store=store, resume=True)
+    assert "search" in third.stages_run
+    assert {"clean", "engineer", "rfe"} <= set(third.stages_skipped)
+    # same flag again: now the checkpoint is valid and search is skipped
+    fourth = run_pipeline(flip(cfg, True), store=store, resume=True)
+    assert "search" in fourth.stages_skipped
+
+
+# --- bootstrap_compile_cache policy (satellite 1) ----------------------------
+
+
+@pytest.fixture()
+def fresh_bootstrap(monkeypatch, tmp_path):
+    """Reset the module's first-call-wins state and spy on the underlying
+    debug helper so tests never mutate live jax.config cache settings."""
+    calls = []
+
+    def spy(cache_dir=None, *, min_compile_time_secs=5.0):
+        calls.append(
+            {"cache_dir": cache_dir, "min_secs": min_compile_time_secs}
+        )
+        return str(tmp_path / "cc")
+
+    monkeypatch.setattr(compilecache, "_bootstrap_done", False)
+    monkeypatch.setattr(compilecache, "_bootstrapped", None)
+    monkeypatch.setattr(
+        "cobalt_smart_lender_ai_tpu.debug.enable_persistent_compile_cache",
+        spy,
+    )
+    monkeypatch.delenv("COBALT_COMPILE_CACHE", raising=False)
+    monkeypatch.delenv("COBALT_COMPILE_CACHE_MIN_SECS", raising=False)
+    return calls
+
+
+def test_bootstrap_first_call_wins(fresh_bootstrap, tmp_path):
+    calls = fresh_bootstrap
+    first = bootstrap_compile_cache(
+        CompileCacheConfig(cache_dir=str(tmp_path / "a"))
+    )
+    assert first == str(tmp_path / "cc")
+    assert len(calls) == 1 and calls[0]["cache_dir"] == str(tmp_path / "a")
+    # later calls (library code, different config) return the first result
+    again = bootstrap_compile_cache(
+        CompileCacheConfig(cache_dir=str(tmp_path / "b"))
+    )
+    assert again == first
+    assert len(calls) == 1
+
+
+def test_bootstrap_env_opt_out(fresh_bootstrap, monkeypatch):
+    monkeypatch.setenv("COBALT_COMPILE_CACHE", "0")
+    assert bootstrap_compile_cache() is None
+    assert fresh_bootstrap == []  # cache never enabled
+
+
+def test_bootstrap_config_disabled(fresh_bootstrap):
+    assert bootstrap_compile_cache(CompileCacheConfig(enabled=False)) is None
+    assert fresh_bootstrap == []
+
+
+def test_bootstrap_env_min_secs_override(fresh_bootstrap, monkeypatch):
+    monkeypatch.setenv("COBALT_COMPILE_CACHE_MIN_SECS", "0")
+    bootstrap_compile_cache(CompileCacheConfig(min_compile_time_secs=5.0))
+    assert fresh_bootstrap[0]["min_secs"] == 0.0
+
+
+def test_compile_telemetry_counts_backend_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    assert install_compile_telemetry()
+    before = compile_stats()
+    assert set(before) == {
+        "backend_compiles",
+        "backend_compile_seconds",
+        "cache_hits",
+        "cache_misses",
+        "cache_saved_seconds",
+    }
+
+    # a shape/closure combination no other test compiles
+    @jax.jit
+    def probe(x):
+        return jnp.cumsum(x * 1.2345) - 0.5
+
+    probe(jnp.arange(173.0)).block_until_ready()
+    after = compile_stats()
+    assert after["backend_compiles"] >= before["backend_compiles"] + 1
+    assert (
+        after["backend_compile_seconds"] > before["backend_compile_seconds"]
+    )

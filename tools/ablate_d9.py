@@ -79,9 +79,9 @@ def main() -> None:
         help="comma-separated variant names to run (default: all)",
     )
     args = ap.parse_args()
-    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+    from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
 
-    enable_persistent_compile_cache()
+    bootstrap_compile_cache()
 
     N, J, T = args.rows, args.jobs, args.trees
     F, B = N_FEATS, N_BINS

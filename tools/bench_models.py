@@ -110,9 +110,9 @@ def main(argv=None):
 
     import jax
 
-    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+    from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
 
-    enable_persistent_compile_cache()
+    bootstrap_compile_cache()
 
     from cobalt_smart_lender_ai_tpu.config import (
         FTTransformerConfig,

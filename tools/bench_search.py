@@ -1,0 +1,285 @@
+"""Search-leg bench: successive halving vs exhaustive on the same grid.
+
+Runs the reference 20x3 randomized-search grid (TuneConfig.param_space)
+twice on identical data, folds and seed — once with the successive-halving
+scheduler, once exhaustive — and reports the `cobalt_search_dispatch_seconds`
+each mode actually spent dispatching tree work, the winner each mode picked,
+and the winner's full-refit test AUC. This is the harness behind the PR-10
+acceptance gate: halving must spend measurably fewer dispatch seconds while
+the refit AUC stays within PARITY_MARGIN of the exhaustive winner's.
+
+Single-mode invocations (``--mode halving|exhaustive``) emit the same JSON
+for one scheduler plus the process's ``cobalt_compile_*`` counters — run one
+twice with a shared ``--cache-dir`` to prove the persistent compile cache
+eliminates the second process's XLA compiles (the CI `search-smoke` job).
+
+    python tools/bench_search.py --smoke --mode both --out BENCH_SEARCH.json
+    python tools/bench_search.py --smoke --mode halving --cache-dir /tmp/cc
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+# repo root (package import) + tools/ (parity.build_matrices import)
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)
+
+
+def run_search(mats, tune_cfg, *, base, mesh):
+    """One randomized_search over the shared matrices; returns the result
+    plus the dispatch-seconds delta attributed to this run's scheduler."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cobalt_smart_lender_ai_tpu.ops.metrics import roc_auc
+    from cobalt_smart_lender_ai_tpu.parallel.tune import randomized_search
+    from cobalt_smart_lender_ai_tpu.telemetry import default_registry
+
+    counter = default_registry().counter(
+        "cobalt_search_dispatch_seconds", "", ("mode",)
+    )
+    before = {
+        m: counter.labels(mode=m).value for m in ("halving", "exhaustive")
+    }
+    t0 = time.time()
+    res = randomized_search(
+        mats["X_train"], mats["y_train"], base, tune_cfg, mesh
+    )
+    wall = time.time() - t0
+    deltas = {
+        m: round(counter.labels(mode=m).value - before[m], 3)
+        for m in ("halving", "exhaustive")
+    }
+    margin = res.best_estimator_.predict_margin(jnp.asarray(mats["X_test"]))
+    test_auc = float(
+        roc_auc(jnp.asarray(mats["y_test"], jnp.float32), margin)
+    )
+    report = res.cv_results_.get("halving")
+    mode = "halving" if report is not None else "exhaustive"
+    out = {
+        "mode": mode,
+        "wall_seconds": round(wall, 1),
+        "dispatch_seconds": deltas[mode],
+        "dispatch_seconds_by_mode": deltas,
+        "best_params": res.best_params_,
+        "cv_auc": round(float(res.best_score_), 6),
+        "test_auc": round(test_auc, 6),
+        "mean_test_score": np.round(
+            res.cv_results_["mean_test_score"], 6
+        ).tolist(),
+    }
+    if report is not None:
+        out["halving"] = {
+            k: report[k]
+            for k in ("eta", "budgets", "rungs", "pruned_candidates",
+                      "survivors", "dispatches")
+        }
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=40_000)
+    ap.add_argument("--seed", type=int, default=5)
+    ap.add_argument(
+        "--mode", choices=("both", "halving", "exhaustive"), default="both"
+    )
+    ap.add_argument(
+        "--smoke",
+        action="store_true",
+        help="CI profile: small rows/bins, fixed small chunk so the "
+        "schedule rungs even at toy scale, compile-cache threshold 0",
+    )
+    ap.add_argument(
+        "--mini-grid",
+        action="store_true",
+        help="miniature 6x2 search grid (48-tree cap) instead of the 20x3 "
+        "reference grid — the schedule still rungs and prunes, at a scale a "
+        "1-core CI host finishes in minutes",
+    )
+    ap.add_argument(
+        "--chunk-trees",
+        default="auto",
+        type=lambda s: s if s == "auto" else (None if s == "none" else int(s)),
+    )
+    ap.add_argument("--eta", type=int, default=2)
+    ap.add_argument("--n-bins", type=int, default=None)
+    ap.add_argument(
+        "--cache-dir",
+        default=None,
+        help="persistent compile cache dir (default: framework default dir)",
+    )
+    ap.add_argument(
+        "--force-devices", type=int, default=0,
+        help="force an N-virtual-device CPU backend (CI mesh smoke)",
+    )
+    ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--check",
+        action="store_true",
+        help="with --mode both: exit nonzero unless halving pruned "
+        "candidates, spent fewer dispatch seconds than exhaustive, and "
+        "the refit AUC is within the parity margin",
+    )
+    args = ap.parse_args(argv)
+
+    if args.force_devices:
+        from cobalt_smart_lender_ai_tpu.debug import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(args.force_devices)
+
+    from cobalt_smart_lender_ai_tpu.compilecache import (
+        bootstrap_compile_cache,
+        compile_stats,
+    )
+    from cobalt_smart_lender_ai_tpu.config import (
+        CompileCacheConfig,
+        GBDTConfig,
+        MeshConfig,
+        TuneConfig,
+    )
+
+    cache_cfg = CompileCacheConfig(
+        cache_dir=args.cache_dir,
+        min_compile_time_secs=0.0 if args.smoke else 5.0,
+    )
+    cache_dir = bootstrap_compile_cache(cache_cfg)
+
+    import jax
+
+    from cobalt_smart_lender_ai_tpu.parallel.mesh import make_mesh
+    from parity import build_matrices
+
+    if args.smoke:
+        rows = min(args.rows, 6000)
+        n_bins = args.n_bins or 32
+        default_chunk = 12 if args.mini_grid else 25
+        chunk = args.chunk_trees if args.chunk_trees != "auto" else default_chunk
+    else:
+        rows = args.rows
+        n_bins = args.n_bins or GBDTConfig.n_bins
+        chunk = args.chunk_trees
+
+    mats = build_matrices(rows, args.seed)
+    base = GBDTConfig().replace(n_bins=n_bins, scale_pos_weight=mats["spw"])
+    mesh = make_mesh(MeshConfig())
+
+    grid_overrides = {}
+    grid_name = "TuneConfig.param_space 20x3 reference grid"
+    if args.mini_grid:
+        grid_overrides = dict(
+            n_iter=6,
+            cv_folds=2,
+            param_space={
+                "n_estimators": (24, 48),
+                "max_depth": (2, 3),
+                "learning_rate": (0.1, 0.3),
+            },
+        )
+        grid_name = "mini 6x2 grid (48-tree cap)"
+
+    def tune_for(halving: bool) -> TuneConfig:
+        return dataclasses.replace(
+            TuneConfig(),
+            chunk_trees=chunk,
+            halving_enabled=halving,
+            halving_eta=args.eta,
+            **grid_overrides,
+        )
+
+    runs = {}
+    modes = (
+        ("halving", "exhaustive") if args.mode == "both" else (args.mode,)
+    )
+    for mode in modes:
+        print(f"[bench_search] running {mode} search on {rows} rows ...")
+        result = run_search(
+            mats, tune_for(mode == "halving"), base=base, mesh=mesh
+        )
+        if args.smoke:
+            # At smoke scale the cold XLA compile wall dwarfs the tree
+            # compute the scheduler saves, so the gated comparison is the
+            # *warm* run (production search legs are warm: the persistent
+            # cache is default-on and the first pass just populated it).
+            # Cold numbers stay in the record for the compile-cache story.
+            cold = result
+            result = run_search(
+                mats, tune_for(mode == "halving"), base=base, mesh=mesh
+            )
+            result["cold_dispatch_seconds"] = cold["dispatch_seconds"]
+            result["cold_wall_seconds"] = cold["wall_seconds"]
+        runs[mode] = result
+        print(
+            f"[bench_search] {mode}: dispatch "
+            f"{runs[mode]['dispatch_seconds']}s, wall "
+            f"{runs[mode]['wall_seconds']}s, test_auc "
+            f"{runs[mode]['test_auc']}"
+        )
+
+    out = {
+        "bench": "search_halving_vs_exhaustive",
+        "rows": rows,
+        "seed": args.seed,
+        "n_bins": n_bins,
+        "chunk_trees": chunk,
+        "eta": args.eta,
+        "grid": grid_name,
+        "backend": jax.devices()[0].platform,
+        "n_devices": jax.device_count(),
+        "host_cpu_cores": os.cpu_count(),
+        "measurement": (
+            "warm (second in-process pass per mode; cold_* fields are the "
+            "first pass that populated the caches)"
+            if args.smoke
+            else "single cold pass per mode"
+        ),
+        "compile_cache_dir": cache_dir,
+        "compile": compile_stats(),
+        "runs": runs,
+    }
+
+    failures = []
+    if args.mode == "both":
+        h, e = runs["halving"], runs["exhaustive"]
+        out["dispatch_seconds_saved"] = round(
+            e["dispatch_seconds"] - h["dispatch_seconds"], 3
+        )
+        out["refit_auc_gap"] = round(h["test_auc"] - e["test_auc"], 6)
+        if args.check:
+            if "halving" not in h:
+                failures.append("halving scheduler did not engage")
+            elif h["halving"]["pruned_candidates"] <= 0:
+                failures.append("halving pruned no candidates")
+            if h["dispatch_seconds"] >= e["dispatch_seconds"]:
+                failures.append(
+                    "halving dispatch seconds not below exhaustive "
+                    f"({h['dispatch_seconds']} vs {e['dispatch_seconds']})"
+                )
+            if abs(out["refit_auc_gap"]) > 0.005:
+                failures.append(
+                    f"refit AUC gap {out['refit_auc_gap']} exceeds 0.005"
+                )
+    out["check_failures"] = failures
+
+    blob = json.dumps(out, indent=2, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob + "\n")
+        print(f"[bench_search] wrote {args.out}")
+    else:
+        print(blob)
+    if failures:
+        print("[bench_search] CHECK FAILED: " + "; ".join(failures))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

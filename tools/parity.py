@@ -88,7 +88,9 @@ def build_matrices(n_rows: int, seed: int):
     }
 
 
-def run_ours(mats, chunk_trees: int | str | None = "auto") -> dict:
+def run_ours(
+    mats, chunk_trees: int | str | None = "auto", halving: bool = True
+) -> dict:
     """This framework's protocol on the shared matrices — the L3 block of
     pipeline.run_pipeline, run directly so both sides consume the same
     arrays."""
@@ -121,7 +123,9 @@ def run_ours(mats, chunk_trees: int | str | None = "auto") -> dict:
     Xtr = jnp.take(jnp.asarray(mats["X_train"]), sel_idx, axis=1)
     Xte = jnp.take(jnp.asarray(mats["X_test"]), sel_idx, axis=1)
     base = GBDTConfig().replace(scale_pos_weight=spw)
-    tune = dataclasses.replace(TuneConfig(), chunk_trees=chunk_trees)
+    tune = dataclasses.replace(
+        TuneConfig(), chunk_trees=chunk_trees, halving_enabled=halving
+    )
     t1 = time.time()
     search = randomized_search(Xtr, mats["y_train"], base, tune, mesh)
     t_search = time.time() - t1
@@ -131,9 +135,17 @@ def run_ours(mats, chunk_trees: int | str | None = "auto") -> dict:
     test_auc = float(
         roc_auc(jnp.asarray(mats["y_test"], jnp.float32), margin)
     )
+    halving_report = search.cv_results_.get("halving")
     return {
         "side": "ours",
         "backend": jax.devices()[0].platform,
+        "scheduler": "halving" if halving_report is not None else "exhaustive",
+        "halving": None
+        if halving_report is None
+        else {
+            k: halving_report[k]
+            for k in ("eta", "budgets", "pruned_candidates", "survivors")
+        },
         "selected_features": selected,
         "best_params": search.best_params_,
         "cv_auc": float(search.best_score_),
@@ -271,15 +283,21 @@ def main(argv=None):
         default="auto",
         type=lambda s: s if s == "auto" else (None if s == "none" else int(s)),
     )
+    ap.add_argument(
+        "--no-halving",
+        action="store_true",
+        help="exhaustive search scheduler (bit-identical to pre-halving "
+        "rounds) instead of successive halving",
+    )
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
     if args.side in ("ours", "both"):
-        from cobalt_smart_lender_ai_tpu.debug import (
-            enable_persistent_compile_cache,
+        from cobalt_smart_lender_ai_tpu.compilecache import (
+            bootstrap_compile_cache,
         )
 
-        enable_persistent_compile_cache()
+        bootstrap_compile_cache()
     if args.side == "merge":
         loaded = [json.load(open(p)) for p in args.inputs]
         by_side = {d.get("side"): d for d in loaded}
@@ -299,11 +317,15 @@ def main(argv=None):
             meta[k] = vals.pop()
         result = merge(by_side["ours"], by_side["oracle"], **meta)
     elif args.side == "both":
-        result = run_head_to_head(args.rows, args.seed, args.chunk_trees)
+        result = run_head_to_head(
+            args.rows, args.seed, args.chunk_trees, halving=not args.no_halving
+        )
     else:
         mats = build_matrices(args.rows, args.seed)
         result = (
-            run_ours(mats, chunk_trees=args.chunk_trees)
+            run_ours(
+                mats, chunk_trees=args.chunk_trees, halving=not args.no_halving
+            )
             if args.side == "ours"
             else run_oracle(mats)
         )

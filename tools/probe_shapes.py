@@ -40,11 +40,11 @@ def main() -> None:
     import jax.numpy as jnp
 
     from cobalt_smart_lender_ai_tpu.config import GBDTConfig
-    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+    from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
     from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTHyperparams, fit_binned
     from cobalt_smart_lender_ai_tpu.parallel.budget import est_tree_seconds
 
-    enable_persistent_compile_cache()
+    bootstrap_compile_cache()
     for name in args.probes.split(","):
         N, F, B, J, T, D = PROBES[name]
         rng = np.random.default_rng(0)

@@ -340,9 +340,9 @@ def main(argv=None):
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s [%(levelname)s] %(message)s"
     )
-    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+    from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
 
-    enable_persistent_compile_cache()  # stages re-run identical programs
+    bootstrap_compile_cache()  # stages re-run identical programs
     if args.stage == "prep":
         stage_prep(args)
     elif args.stage == "stages":

@@ -227,10 +227,10 @@ def main(argv=None) -> None:
                     "fixture for tests/CI; never use in production)")
     args = ap.parse_args(argv)
 
-    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+    from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
     from cobalt_smart_lender_ai_tpu.io import ObjectStore
 
-    enable_persistent_compile_cache()
+    bootstrap_compile_cache()
     report = retrain_candidate(
         ObjectStore(args.store),
         rows=args.rows,

@@ -47,12 +47,12 @@ def main(argv=None) -> None:
     )
     from cobalt_smart_lender_ai_tpu.data import schema
     from cobalt_smart_lender_ai_tpu.data.features import drop_training_leakage
-    from cobalt_smart_lender_ai_tpu.debug import enable_persistent_compile_cache
+    from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
     from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
     from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
     from cobalt_smart_lender_ai_tpu.ops.metrics import roc_auc
 
-    enable_persistent_compile_cache()
+    bootstrap_compile_cache()
     t0 = time.time()
     raw = synthetic_lendingclub_frame(n_rows=args.rows, seed=args.seed)
     cleaned, _ = clean_raw_frame(raw)
