@@ -135,16 +135,43 @@ def main() -> None:
         action="store_true",
         help="time the full run_pipeline protocol instead of the single fit",
     )
+    parser.add_argument(
+        "--ledger-out",
+        default=None,
+        help="write a run ledger (env, stage durations, program cost table) "
+        "to this path; render with tools/obs_report.py",
+    )
     args = parser.parse_args()
 
     from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
 
     bootstrap_compile_cache()
+    ledger = None
+    if args.ledger_out:
+        from cobalt_smart_lender_ai_tpu.telemetry import (
+            RunLedger,
+            install_device_metrics,
+            install_program_metrics,
+        )
+
+        install_program_metrics()
+        install_device_metrics()
+        ledger = RunLedger(
+            "bench",
+            meta={"rows": args.rows, "protocol": bool(args.protocol)},
+        )
     if args.protocol:
         from cobalt_smart_lender_ai_tpu.debug import profile_trace as _trace
 
         with _trace(args.profile):
             out = run_protocol(args.rows)
+        if ledger is not None:
+            ledger.add_stages(out.get("seconds_stage") or {})
+            ledger.set(
+                "headline",
+                {k: out[k] for k in out if k != "telemetry"},
+            )
+            ledger.write(args.ledger_out)
         print(json.dumps(out))
         return
 
@@ -250,6 +277,12 @@ def main() -> None:
             "n_rows": proto.get("n_rows"),
             "vs_baseline": proto.get("vs_baseline"),
         }
+    if ledger is not None:
+        ledger.add_stage("full_table_fit", elapsed)
+        ledger.set(
+            "headline", {k: line[k] for k in line if k != "telemetry"}
+        )
+        ledger.write(args.ledger_out)
     print(json.dumps(line))
 
 

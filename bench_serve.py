@@ -479,6 +479,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="write the run's span ring as Chrome Trace "
                         "Event / Perfetto JSON to this path (open in "
                         "ui.perfetto.dev; CI uploads it as an artifact)")
+    parser.add_argument("--ledger-out", default=None,
+                        help="write a run ledger (env, headline, program "
+                        "cost table) to this path; render with "
+                        "tools/obs_report.py")
     args = parser.parse_args(argv)
     if args.force_devices:
         import os
@@ -497,6 +501,39 @@ def main(argv: list[str] | None = None) -> int:
         args.rows = min(args.rows, 800)
         args.bulk_rows = min(args.bulk_rows, 16384)
         args.bulk_repeats = min(args.bulk_repeats, 2)
+
+    def _write_ledger(record: dict) -> None:
+        if not args.ledger_out:
+            return
+        from cobalt_smart_lender_ai_tpu.telemetry import (
+            RunLedger,
+            install_device_metrics,
+            install_program_metrics,
+        )
+
+        install_program_metrics()
+        install_device_metrics()
+        ledger = RunLedger(
+            "bench_serve",
+            meta={
+                "bulk": bool(args.bulk),
+                "clients": args.clients,
+                "duration_s": args.duration_s,
+                "rows": args.rows,
+                "mix": args.mix,
+            },
+        )
+        ledger.set(
+            "headline",
+            {k: v for k, v in record.items() if k != "results"}
+            | {
+                name: {k: v for k, v in r.items() if k != "telemetry"}
+                for name, r in (record.get("results") or {}).items()
+            },
+        )
+        ledger.write(args.ledger_out)
+        print(f"[bench] run ledger written to {args.ledger_out}",
+              file=sys.stderr)
 
     if args.bulk:
         print(f"[bench] training model ({args.rows} synthetic rows)...",
@@ -522,6 +559,7 @@ def main(argv: list[str] | None = None) -> int:
         if args.out:
             with open(args.out, "w") as fh:
                 fh.write(line + "\n")
+        _write_ledger(record)
         return 0
 
     from cobalt_smart_lender_ai_tpu.config import ServeConfig
@@ -625,6 +663,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.out:
         with open(args.out, "w") as fh:
             fh.write(line + "\n")
+    _write_ledger(record)
     if args.trace_out:
         from cobalt_smart_lender_ai_tpu.telemetry import (
             default_tracer,

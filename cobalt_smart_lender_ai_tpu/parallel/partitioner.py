@@ -29,6 +29,7 @@ from __future__ import annotations
 import abc
 import re
 import threading
+import time
 from functools import partial
 from typing import Any, Callable, Sequence
 
@@ -40,6 +41,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from cobalt_smart_lender_ai_tpu.explain.treeshap import shap_values
 from cobalt_smart_lender_ai_tpu.models.gbdt import predict_margin
 from cobalt_smart_lender_ai_tpu.parallel.compat import shard_map
+from cobalt_smart_lender_ai_tpu.telemetry.programs import (
+    default_program_registry,
+)
 
 __all__ = [
     "MeshPartitioner",
@@ -88,6 +92,34 @@ def _exec_cache_put(key: tuple, compiled: Any) -> Any:
     # published wins so every caller closes over the same handle.
     with _EXEC_LOCK:
         return _EXEC_CACHE.setdefault(key, compiled)
+
+
+def _program_for(
+    kind: str, *, rows: int, n_features: int, device: Any = None, shards: int = 1
+):
+    """ProgramRegistry handle for a serving program — the observatory's
+    hook into this cache. The name is the stable shape key an operator
+    reads off ``GET /debug/programs``; a pinned device lands in the name
+    (and ``device`` meta) so each replica's programs stay distinct rows."""
+    meta: dict[str, Any] = {
+        "rows_per_dispatch": rows,
+        "features": n_features,
+        "shards": shards,
+    }
+    name = f"serve.{kind}[rows={rows},features={n_features}"
+    if shards > 1:
+        name += f",shards={shards}"
+    if device is not None:
+        meta["device"] = str(device)
+        meta["device_kind"] = str(getattr(device, "device_kind", "unknown"))
+        name += f",device={device}"
+    else:
+        try:
+            meta["device_kind"] = str(jax.devices()[0].device_kind)
+        except Exception:
+            pass
+    name += "]"
+    return default_program_registry().register(name, kind="serve", meta=meta)
 
 
 def match_partition_rule(
@@ -186,8 +218,12 @@ class SingleDevicePartitioner(Partitioner):
             "margin", self._device, rows, n_features,
             _forest_fingerprint(forest),
         )
+        prog = _program_for(
+            "margin", rows=rows, n_features=n_features, device=self._device
+        )
         compiled = _exec_cache_get(key)
         if compiled is None:
+            t0 = time.perf_counter()
             with self._ctx():
                 compiled = (
                     jax.jit(predict_margin)
@@ -197,16 +233,23 @@ class SingleDevicePartitioner(Partitioner):
                     )
                     .compile()
                 )
+            prog.record_compile(time.perf_counter() - t0, compiled)
             compiled = _exec_cache_put(key, compiled)
-        return lambda X: compiled(forest, X)
+        else:
+            prog.ensure_cost(compiled)
+        return prog.wrap(lambda X: compiled(forest, X))
 
     def compile_shap(self, forest, n_features, rows):
         key = (
             "shap", self._device, rows, n_features,
             _forest_fingerprint(forest),
         )
+        prog = _program_for(
+            "shap", rows=rows, n_features=n_features, device=self._device
+        )
         compiled = _exec_cache_get(key)
         if compiled is None:
+            t0 = time.perf_counter()
             with self._ctx():
                 compiled = (
                     jax.jit(partial(shap_values, n_features=n_features))
@@ -216,8 +259,11 @@ class SingleDevicePartitioner(Partitioner):
                     )
                     .compile()
                 )
+            prog.record_compile(time.perf_counter() - t0, compiled)
             compiled = _exec_cache_put(key, compiled)
-        return lambda X: compiled(forest, X)
+        else:
+            prog.ensure_cost(compiled)
+        return prog.wrap(lambda X: compiled(forest, X))
 
     def describe(self) -> dict:
         out = super().describe()
@@ -272,6 +318,12 @@ class MeshPartitioner(Partitioner):
             "mesh_margin", self._mesh_key(), rows, n_features,
             _forest_fingerprint(forest),
         )
+        prog = _program_for(
+            "mesh_margin",
+            rows=rows,
+            n_features=n_features,
+            shards=self.n_shards,
+        )
         compiled = _exec_cache_get(key)
         if compiled is None:
 
@@ -285,6 +337,7 @@ class MeshPartitioner(Partitioner):
             def _margin(forest_l, X_l):
                 return predict_margin(forest_l, X_l)
 
+            t0 = time.perf_counter()
             compiled = (
                 jax.jit(_margin)
                 .lower(
@@ -293,14 +346,23 @@ class MeshPartitioner(Partitioner):
                 )
                 .compile()
             )
+            prog.record_compile(time.perf_counter() - t0, compiled)
             compiled = _exec_cache_put(key, compiled)
-        return lambda X: compiled(forest, X)
+        else:
+            prog.ensure_cost(compiled)
+        return prog.wrap(lambda X: compiled(forest, X))
 
     def compile_shap(self, forest, n_features, rows):
         self._check_rows(rows)
         key = (
             "mesh_shap", self._mesh_key(), rows, n_features,
             _forest_fingerprint(forest),
+        )
+        prog = _program_for(
+            "mesh_shap",
+            rows=rows,
+            n_features=n_features,
+            shards=self.n_shards,
         )
         compiled = _exec_cache_get(key)
         if compiled is None:
@@ -317,6 +379,7 @@ class MeshPartitioner(Partitioner):
             def _shap(forest_l, X_l):
                 return shap_values(forest_l, X_l, n_features=n_features)
 
+            t0 = time.perf_counter()
             compiled = (
                 jax.jit(_shap)
                 .lower(
@@ -325,8 +388,11 @@ class MeshPartitioner(Partitioner):
                 )
                 .compile()
             )
+            prog.record_compile(time.perf_counter() - t0, compiled)
             compiled = _exec_cache_put(key, compiled)
-        return lambda X: compiled(forest, X)
+        else:
+            prog.ensure_cost(compiled)
+        return prog.wrap(lambda X: compiled(forest, X))
 
 
 def make_partitioner(

@@ -48,6 +48,33 @@ from cobalt_smart_lender_ai_tpu.telemetry import default_registry, span
 logger = logging.getLogger("cobalt_smart_lender_ai_tpu.tune")
 
 
+def _cv_program(mode: str, *, depth: int, chunk: int, n_bins: int):
+    """ProgramRegistry handle for a CV chunk-advance runner. The name IS
+    the runner's program-structure key — `_make_cv_runner`'s program
+    depends only on (chunk, depth, bins, mesh axes) — so every dispatch
+    through one compiled program lands on one table row, whatever bucket
+    or rung issued it. Dispatch seconds recorded here are loop wall
+    bounded by a scalar sync (the same quantity
+    ``cobalt_search_dispatch_seconds`` counts), so the ledger's
+    attribution ratio closes."""
+    from cobalt_smart_lender_ai_tpu.telemetry.programs import (
+        default_program_registry,
+    )
+
+    name = (
+        f"search.cv_runner[mode={mode},depth={depth},"
+        f"chunk={chunk},bins={n_bins}]"
+    )
+    meta: dict[str, Any] = {
+        "mode": mode, "depth": depth, "chunk_trees": chunk, "n_bins": n_bins,
+    }
+    try:
+        meta["device_kind"] = str(jax.devices()[0].device_kind)
+    except Exception:
+        pass
+    return default_program_registry().register(name, kind="search", meta=meta)
+
+
 def _search_metrics():
     """``cobalt_search_*`` family, resolved at call time so tests that swap
     the default registry see fresh counters."""
@@ -459,9 +486,13 @@ def cross_validate_gbdt(
     # enqueues); same counter the halving scheduler feeds, so bench/CI can
     # compare tree-dispatch seconds across scheduler modes.
     np.asarray(margins[:1, :1])
+    loop_wall = time.time() - t_loop
     _search_metrics()["dispatch_seconds"].labels(mode="exhaustive").inc(
-        time.time() - t_loop
+        loop_wall
     )
+    _cv_program(
+        "exhaustive", depth=depth_cap, chunk=schedule[0][1], n_bins=n_bins
+    ).record_dispatch(loop_wall, count=len(schedule))
     aucs = _score_jobs(margins, val_p, w_p, job_fold, y_p.astype(jnp.float32))
     return aucs[:n_jobs].reshape(C, K)
 
@@ -801,6 +832,7 @@ def successive_halving_search(
     pruned_total = 0
     for ri, budget_trees in enumerate(budgets):
         t0 = time.time()
+        rung_disp: dict[tuple[int, int], int] = {}
         with span(
             "search.rung",
             rung=ri,
@@ -808,7 +840,12 @@ def successive_halving_search(
             live=sum(len(b.live) for b in buckets),
         ):
             for b in buckets:
+                before = ctx.dispatches
                 b.advance(budget_trees)
+                bkey = (b.depth, b.chunk)
+                rung_disp[bkey] = (
+                    rung_disp.get(bkey, 0) + ctx.dispatches - before
+                )
             cand_mean: dict[int, float] = {}
             for b in buckets:
                 sc = b.scores()
@@ -816,10 +853,30 @@ def successive_halving_search(
                     split_scores[cid] = sc[pos]
                     scored_at[cid] = min(budget_trees, cfgs[cid].n_estimators)
                     cand_mean[cid] = float(sc[pos].mean())
-        metrics["dispatch_seconds"].labels(mode="halving").inc(
-            time.time() - t0
-        )
+        rung_wall = time.time() - t0
+        metrics["dispatch_seconds"].labels(mode="halving").inc(rung_wall)
         metrics["rungs"].inc()
+        # Attribute the (sync-bounded by scores()) rung wall to the depth
+        # runners that dispatched, proportional to their dispatch counts —
+        # an estimate, flagged as such in obs_report, but it sums to the
+        # measured counter exactly, so the ledger's residual stays zero. A
+        # rung that advanced nothing (every bucket already at cap) spent
+        # its wall purely in the scoring program.
+        total_d = sum(rung_disp.values())
+        if total_d > 0:
+            for (d, ck), nd in rung_disp.items():
+                if nd:
+                    _cv_program(
+                        "halving", depth=d, chunk=ck, n_bins=base.n_bins
+                    ).record_dispatch(rung_wall * nd / total_d, count=nd)
+        else:
+            from cobalt_smart_lender_ai_tpu.telemetry.programs import (
+                default_program_registry,
+            )
+
+            default_program_registry().register(
+                "search.score_jobs[mode=halving]", kind="search"
+            ).record_dispatch(rung_wall, count=len(buckets))
         n_live = len(cand_mean)
         if ri == len(budgets) - 1:
             rung_report.append(

@@ -486,6 +486,13 @@ def main(argv=None) -> PipelineResult:
         help="write the run's stage spans as Chrome Trace Event / Perfetto "
         "JSON to this path (open in ui.perfetto.dev)",
     )
+    parser.add_argument(
+        "--ledger-out",
+        default=None,
+        help="write a run ledger (JSON: config fingerprint, env/devices, "
+        "stage durations, search rungs, program cost table) to this path; "
+        "render it with tools/obs_report.py",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -521,7 +528,58 @@ def main(argv=None) -> PipelineResult:
 
         raw = synthetic_lendingclub_frame(args.synthetic_rows, seed=args.seed)
     store = ObjectStore(args.store) if args.store else None
+    ledger = None
+    if args.ledger_out:
+        from cobalt_smart_lender_ai_tpu.telemetry import (
+            RunLedger,
+            install_device_metrics,
+            install_program_metrics,
+        )
+
+        # Publish the observatory families onto the process registry up
+        # front so the ledger's metrics snapshot carries them too.
+        install_program_metrics()
+        install_device_metrics()
+        ledger = RunLedger(
+            "pipeline",
+            fingerprint=config_fingerprint(
+                "search", cfg.data, cfg.rfe, cfg.gbdt, cfg.tune, cfg.mesh
+            ),
+            meta={
+                "quick": bool(args.quick),
+                "halving": not args.no_halving,
+                "synthetic_rows": int(args.synthetic_rows),
+                "seed": int(args.seed),
+                "resume": bool(args.resume),
+                "store": args.store,
+            },
+        )
     result = run_pipeline(cfg, raw=raw, store=store, resume=args.resume)
+    if ledger is not None:
+        ledger.add_stages(result.timings)
+        ledger.set(
+            "final_metrics",
+            {
+                "test_auc": result.test_auc,
+                "cv_auc": result.cv_auc,
+                "best_params": result.best_params,
+                "n_selected": len(result.selected_features),
+            },
+        )
+        halving_report = result.search.cv_results_.get("halving")
+        if halving_report is not None:
+            ledger.set("search_halving", halving_report)
+        ledger.set(
+            "stages_run",
+            {
+                "run": list(result.stages_run),
+                "skipped": list(result.stages_skipped),
+            },
+        )
+        ledger.write(args.ledger_out)
+        logging.getLogger(__name__).info(
+            "run ledger written to %s", args.ledger_out
+        )
     if args.trace_out:
         from cobalt_smart_lender_ai_tpu.telemetry import (
             default_tracer,

@@ -350,22 +350,49 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
             raise HTTPException(status_code=404, detail="SLO engine disabled")
         return svc.slo.evaluate(force=True)
 
+    def _debug_params(limit, fallback: int, phase):
+        """Validate the debug routes' query params against the same bounds
+        and 422 taxonomy as the stdlib adapter (validated manually, not via
+        pydantic — the stub harness calls handlers directly)."""
+        from cobalt_smart_lender_ai_tpu.serve.http_stdlib import (
+            validate_debug_limit,
+            validate_debug_phase,
+        )
+
+        try:
+            return (
+                validate_debug_limit(limit if limit is not None else fallback),
+                validate_debug_phase(phase),
+            )
+        except RequestError as e:
+            _raise_typed(e)
+
     @app.get("/debug/requests")
-    def debug_requests(n: int = 50):
+    def debug_requests(n: int = 50, limit: int = None, phase: str = None):
         flight = state["service"].flight
+        n, phase = _debug_params(limit, n, phase)
         return {
-            "recent": flight.records(n),
-            "errors": flight.errors(n),
+            "recent": flight.records(n, phase),
+            "errors": flight.errors(n, phase),
             "stats": flight.stats(),
         }
 
     @app.get("/debug/slowest")
-    def debug_slowest(k: int = 0):
+    def debug_slowest(k: int = 0, limit: int = None, phase: str = None):
         flight = state["service"].flight
+        k, phase = _debug_params(limit, k or flight.top_k, phase)
         return {
-            "slowest": flight.slowest(k or flight.top_k),
+            "slowest": flight.slowest(k, phase),
             "stats": flight.stats(),
         }
+
+    @app.get("/debug/programs")
+    def debug_programs():
+        from cobalt_smart_lender_ai_tpu.serve.http_stdlib import (
+            debug_programs_payload,
+        )
+
+        return debug_programs_payload()
 
     @app.get("/debug/trace")
     def debug_trace():

@@ -24,9 +24,16 @@ match the reference:
   application/openmetrics-text`` the latency buckets carry exemplar
   trace ids
 - ``GET /slo``                    — SLO burn-rate report (telemetry.slo)
-- ``GET /debug/requests``         — recent flight records (``?n=``)
-- ``GET /debug/slowest``          — top-K requests by wall time (``?k=``)
+- ``GET /debug/requests``         — recent flight records (``?limit=``,
+  ``?phase=`` to keep only records that spent time in one serving phase;
+  legacy ``?n=`` still accepted)
+- ``GET /debug/slowest``          — top-K requests by wall time
+  (``?limit=``/``?k=``, ``?phase=``)
 - ``GET /debug/trace``            — span ring as Chrome-trace/Perfetto JSON
+  (plus sampled counter tracks)
+- ``GET /debug/programs``         — the process program cost table
+  (telemetry.programs): per compiled program, compile wall, cost_analysis
+  estimates, dispatch count/seconds, achieved FLOP/s
 
 Errors return ``{"detail": ...}`` like FastAPI's HTTPException, plus a stable
 machine-readable ``"error"`` code from `reliability.errors` — the taxonomy
@@ -65,11 +72,17 @@ from cobalt_smart_lender_ai_tpu.telemetry import (
     OPENMETRICS_CONTENT_TYPE,
     TRACE_CONTENT_TYPE,
     collect_phases,
+    default_program_registry,
     default_tracer,
     get_logger,
     render_chrome_trace,
     request_context,
 )
+from cobalt_smart_lender_ai_tpu.telemetry.flight import PHASES
+
+#: Hard ceiling for ``?limit=`` on the debug routes — forensics must never
+#: turn into an unbounded dump (both adapters validate against this).
+DEBUG_LIMIT_MAX = 1000
 
 _LOG = get_logger("cobalt.serve.http")
 
@@ -91,8 +104,36 @@ _KNOWN_ROUTES = frozenset(
         "/debug/requests",
         "/debug/slowest",
         "/debug/trace",
+        "/debug/programs",
     }
 )
+
+
+def validate_debug_limit(value: int, name: str = "limit") -> int:
+    """Shared ``limit`` bound for the debug routes (1..DEBUG_LIMIT_MAX),
+    422 outside it — used by both adapters so the taxonomy stays equal."""
+    if not 1 <= value <= DEBUG_LIMIT_MAX:
+        raise ValidationError(
+            f"query param {name!r} must be between 1 and {DEBUG_LIMIT_MAX}"
+        )
+    return value
+
+
+def validate_debug_phase(phase: str | None) -> str | None:
+    """Shared ``phase`` validation: must be one of the canonical serving
+    phases (telemetry.flight.PHASES), 422 otherwise."""
+    if phase is not None and phase not in PHASES:
+        raise ValidationError(
+            f"query param 'phase' must be one of {sorted(PHASES)}"
+        )
+    return phase
+
+
+def debug_programs_payload() -> dict:
+    """``GET /debug/programs`` body, shared by both adapters: the program
+    cost table plus its totals line."""
+    reg = default_program_registry()
+    return {"programs": reg.table(), "totals": reg.totals()}
 
 
 def _extract_csv(body: bytes, content_type: str) -> bytes:
@@ -338,6 +379,17 @@ def make_handler(service: ScorerService):
             except ValueError:
                 raise ValidationError(f"query param {name!r} must be an integer")
 
+        def _query_limit(self, legacy: str, default: int) -> int:
+            """``?limit=`` (``?n=``/``?k=`` still accepted), bounded."""
+            name = "limit" if "limit" in self._query else legacy
+            value = self._query_int(name, default)
+            return validate_debug_limit(value, name)
+
+        def _query_phase(self) -> str | None:
+            return validate_debug_phase(
+                self._query.get("phase", [None])[-1]
+            )
+
         def _get(self) -> None:
             path = self._route_path
             if path == "/healthz":
@@ -370,24 +422,28 @@ def make_handler(service: ScorerService):
             elif path == "/drift":
                 self._send(200, service.drift_report())
             elif path == "/debug/requests":
-                n = self._query_int("n", 50)
+                n = self._query_limit("n", 50)
+                phase = self._query_phase()
                 self._send(
                     200,
                     {
-                        "recent": service.flight.records(n),
-                        "errors": service.flight.errors(n),
+                        "recent": service.flight.records(n, phase),
+                        "errors": service.flight.errors(n, phase),
                         "stats": service.flight.stats(),
                     },
                 )
             elif path == "/debug/slowest":
-                k = self._query_int("k", service.flight.top_k)
+                k = self._query_limit("k", service.flight.top_k)
+                phase = self._query_phase()
                 self._send(
                     200,
                     {
-                        "slowest": service.flight.slowest(k),
+                        "slowest": service.flight.slowest(k, phase),
                         "stats": service.flight.stats(),
                     },
                 )
+            elif path == "/debug/programs":
+                self._send(200, debug_programs_payload())
             elif path == "/debug/trace":
                 self._send_bytes(
                     200,

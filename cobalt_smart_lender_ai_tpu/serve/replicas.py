@@ -246,6 +246,20 @@ class ReplicaSet:
         )
         self._model_info_labels = ("unversioned", "direct", "none")
         self._m_model_info.labels(*self._model_info_labels).set(1.0)
+        # Performance-observatory fleet merge: the facade /metrics carries
+        # the per-replica program/dispatch families under a ``replica``
+        # label, so one scrape attributes fleet time to compiled programs
+        # without visiting N replica registries.
+        c_bulk_rows = reg.counter(
+            "cobalt_bulk_rows_total",
+            "rows scored through each replica's bulk (sharded) path",
+            ("replica",),
+        )
+        c_bulk_disp = reg.counter(
+            "cobalt_bulk_dispatches_total",
+            "device dispatches issued by each replica's bulk path",
+            ("replica",),
+        )
         for i, rep in enumerate(self.replicas):
             g_inflight.labels(replica=str(i)).set_function(
                 lambda i=i: self._inflight[i]
@@ -255,6 +269,32 @@ class ReplicaSet:
                 if r.batcher is None
                 else r.batcher.queue_depth()
             )
+            c_bulk_rows.labels(replica=str(i)).set_function(
+                lambda r=rep: r._m_bulk_rows.value
+            )
+            c_bulk_disp.labels(replica=str(i)).set_function(
+                lambda r=rep: r._m_bulk_dispatches.value
+            )
+        from cobalt_smart_lender_ai_tpu.telemetry.devices import (
+            install_device_metrics,
+        )
+        from cobalt_smart_lender_ai_tpu.telemetry.programs import (
+            default_program_registry,
+        )
+
+        preg = default_program_registry()
+        if any(rep._device is not None for rep in self.replicas):
+            # Pinned fleet: each replica's compiled programs carry its
+            # device in their meta, so a device-filtered publication gives
+            # every replica exactly its own rows.
+            for i, rep in enumerate(self.replicas):
+                preg.publish(reg, replica=str(i), device=str(rep._device))
+        else:
+            # Thread-backed replicas share the one device and hence the
+            # structure-keyed executables; a replica label would just
+            # replicate identical rows N times.
+            preg.publish(reg)
+        install_device_metrics(reg)
 
     # -- routing ---------------------------------------------------------------
 

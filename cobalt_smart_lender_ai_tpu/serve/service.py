@@ -550,6 +550,16 @@ class MicroBatcher:
             "cobalt_microbatch_queue_depth",
             "requests currently waiting for a batch slot",
         ).set_function(self.queue_depth)
+        # Queue depth as a sampled series too: when the device sampler runs
+        # (serve --trace-out, bench harnesses), GET /debug/trace draws it
+        # as a Perfetto counter track beside the request spans.
+        from cobalt_smart_lender_ai_tpu.telemetry.devices import (
+            default_device_sampler,
+        )
+
+        default_device_sampler().add_series(
+            "microbatch_queue_depth", self.queue_depth
+        )
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="microbatcher"
         )
@@ -626,6 +636,11 @@ class MicroBatcher:
             self._closed = True
             self._cond.notify_all()
         self._thread.join(timeout=10.0)
+        from cobalt_smart_lender_ai_tpu.telemetry.devices import (
+            default_device_sampler,
+        )
+
+        default_device_sampler().remove_series("microbatch_queue_depth")
 
     def stats(self) -> dict:
         batches = self.batches
@@ -999,6 +1014,19 @@ class ScorerService:
         )
         self._model_info_labels = ("unversioned", "direct", "none")
         self._m_model_info.labels(*self._model_info_labels).set(1.0)
+        # Performance observatory: the process program cost table
+        # (telemetry.programs) and device/host memory gauges ride this
+        # service's scrape, so /metrics and GET /debug/programs tell one
+        # story. Collect-time callbacks — nothing added to the request path.
+        from cobalt_smart_lender_ai_tpu.telemetry.devices import (
+            install_device_metrics,
+        )
+        from cobalt_smart_lender_ai_tpu.telemetry.programs import (
+            install_program_metrics,
+        )
+
+        install_program_metrics(reg)
+        install_device_metrics(reg)
 
     def observe_request(
         self,

@@ -21,13 +21,30 @@ three legs:
   breakdowns and always-capture rules for slow/error requests
   (``GET /debug/requests``, ``GET /debug/slowest``).
 - `telemetry.traceexport` — the span ring as Chrome Trace Event / Perfetto
-  JSON (``GET /debug/trace``).
+  JSON (``GET /debug/trace``), including sampled counter tracks.
 - `telemetry.slo` — declarative objectives evaluated as multi-window
   error-budget burn rates (``GET /slo``, ``cobalt_slo_*`` gauges).
+
+The performance observatory (README "Run observability") adds three legs:
+
+- `telemetry.programs` — process-wide `ProgramRegistry` of every compiled
+  executable: compile wall, cost_analysis estimates, dispatch count +
+  seconds (``GET /debug/programs``, ``cobalt_program_*``).
+- `telemetry.devices` — device/host memory gauges and the background
+  `DeviceSampler` feeding Perfetto counter tracks.
+- `telemetry.runledger` — one JSON `RunLedger` artifact per run, rendered
+  and diffed by ``tools/obs_report.py``.
 """
 
 from __future__ import annotations
 
+from cobalt_smart_lender_ai_tpu.telemetry.devices import (
+    DeviceSampler,
+    default_device_sampler,
+    device_info,
+    host_rss_bytes,
+    install_device_metrics,
+)
 from cobalt_smart_lender_ai_tpu.telemetry.drift import (
     FeatureSketch,
     psi,
@@ -58,6 +75,17 @@ from cobalt_smart_lender_ai_tpu.telemetry.metrics import (
     parse_exposition,
     render,
 )
+from cobalt_smart_lender_ai_tpu.telemetry.programs import (
+    ProgramHandle,
+    ProgramRegistry,
+    default_program_registry,
+    install_program_metrics,
+    program_table,
+)
+from cobalt_smart_lender_ai_tpu.telemetry.runledger import (
+    RunLedger,
+    load_ledger,
+)
 from cobalt_smart_lender_ai_tpu.telemetry.slo import (
     Objective,
     SLOEngine,
@@ -84,12 +112,16 @@ __all__ = [
     "OPENMETRICS_CONTENT_TYPE",
     "TRACE_CONTENT_TYPE",
     "Counter",
+    "DeviceSampler",
     "FeatureSketch",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "Objective",
+    "ProgramHandle",
+    "ProgramRegistry",
+    "RunLedger",
     "SLOEngine",
     "Span",
     "StructuredLogger",
@@ -99,13 +131,21 @@ __all__ = [
     "collect_phases",
     "current_request_id",
     "current_trace_ids",
+    "default_device_sampler",
     "default_objectives",
+    "default_program_registry",
     "default_registry",
     "default_tracer",
+    "device_info",
     "get_logger",
+    "host_rss_bytes",
+    "install_device_metrics",
+    "install_program_metrics",
+    "load_ledger",
     "log_buckets",
     "new_request_id",
     "parse_exposition",
+    "program_table",
     "psi",
     "record_span",
     "render",

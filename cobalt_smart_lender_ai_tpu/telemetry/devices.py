@@ -1,0 +1,261 @@
+"""Device + host telemetry: memory gauges and sampled counter series.
+
+Two legs, both degrading gracefully on backends that report nothing
+(CPU's `memory_stats()` is typically None; tunneled TPU backends
+occasionally raise mid-poll):
+
+- `install_device_metrics` publishes ``cobalt_device_mem_bytes{device}``
+  and ``cobalt_host_rss_bytes`` gauges onto a `MetricsRegistry` as
+  collect-time callbacks — the same NaN-on-failure contract every other
+  ``set_function`` gauge in the stack has, so a CPU scrape shows NaN
+  rather than a missing family or a 500.
+- `DeviceSampler` is a background daemon thread that snapshots the same
+  values (plus any registered extra series — the micro-batcher registers
+  its queue depth) into bounded rings, which `telemetry.traceexport`
+  renders as Perfetto **counter tracks** (``"ph": "C"``) beside the span
+  timeline. A queue-depth counter track next to request spans is exactly
+  the picture a queue-wait investigation needs.
+
+Stdlib-only; the sampler is opt-in (`default_device_sampler().start()`)
+so nothing spawns a thread unless a harness or server asks for one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+__all__ = [
+    "DeviceSampler",
+    "default_device_sampler",
+    "device_info",
+    "host_rss_bytes",
+    "install_device_metrics",
+]
+
+
+def host_rss_bytes() -> float | None:
+    """Resident set size of this process in bytes, or None when the
+    platform offers no cheap way to read it (no psutil dependency: Linux
+    reads ``/proc/self/status``, elsewhere ``resource`` peak RSS stands
+    in)."""
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) * 1024.0
+    except Exception:
+        pass
+    try:
+        import resource
+        import sys
+
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss is KiB on Linux, bytes on macOS — and it is the peak,
+        # not the current, RSS; a degraded stand-in, clearly better than
+        # nothing for a run ledger.
+        return float(rss) * (1.0 if sys.platform == "darwin" else 1024.0)
+    except Exception:
+        return None
+
+
+def _device_mem_stats(device: Any) -> dict[str, float]:
+    """``device.memory_stats()`` guarded: {} on None/missing/raise (CPU)."""
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return {}
+    if not isinstance(stats, dict):
+        return {}
+    out = {}
+    for k, v in stats.items():
+        try:
+            out[str(k)] = float(v)
+        except Exception:
+            continue
+    return out
+
+
+def device_info() -> list[dict[str, Any]]:
+    """One JSON-able row per visible device (id, kind, platform, memory
+    stats where the backend reports them) — the run ledger's ``devices``
+    block. Returns [] when JAX itself is unavailable/broken."""
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:
+        return []
+    rows = []
+    for d in devices:
+        row: dict[str, Any] = {
+            "id": int(getattr(d, "id", -1)),
+            "kind": str(getattr(d, "device_kind", "unknown")),
+            "platform": str(getattr(d, "platform", "unknown")),
+            "str": str(d),
+        }
+        mem = _device_mem_stats(d)
+        if mem:
+            row["memory_stats"] = {
+                k: mem[k]
+                for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+                if k in mem
+            } or mem
+        rows.append(row)
+    return rows
+
+
+def install_device_metrics(metrics_registry: Any | None = None) -> None:
+    """Publish the device/host memory gauges onto ``metrics_registry``
+    (default: the process-wide registry, resolved at call time). Safe to
+    call repeatedly — callbacks are simply rewired."""
+    if metrics_registry is None:
+        from cobalt_smart_lender_ai_tpu.telemetry.metrics import (
+            default_registry,
+        )
+
+        metrics_registry = default_registry()
+    try:
+        import jax
+
+        devices = list(jax.devices())
+    except Exception:
+        devices = []
+    g_mem = metrics_registry.gauge(
+        "cobalt_device_mem_bytes",
+        "bytes in use on each device per memory_stats() "
+        "(NaN where the backend reports nothing — every CPU)",
+        ("device",),
+    )
+    for d in devices:
+
+        def _bytes_in_use(dev=d) -> float:
+            stats = _device_mem_stats(dev)
+            return stats.get("bytes_in_use", float("nan"))
+
+        g_mem.labels(device=str(d)).set_function(_bytes_in_use)
+    metrics_registry.gauge(
+        "cobalt_host_rss_bytes",
+        "resident set size of this process (NaN when unreadable)",
+    ).set_function(lambda: host_rss_bytes() or float("nan"))
+
+
+class DeviceSampler:
+    """Background sampler feeding Perfetto counter tracks.
+
+    Samples every ``interval_s`` into per-series bounded rings of
+    ``(t_monotonic_s, value)`` pairs. Built-in series: one
+    ``device_mem_bytes:<device>`` per device that actually reports memory
+    stats, plus ``host_rss_bytes``. Extra series (queue depth, in-flight
+    counts) register via `add_series(name, fn)`; a callback that raises is
+    simply skipped for that tick — same degrade posture as the gauges."""
+
+    def __init__(
+        self,
+        *,
+        interval_s: float = 0.25,
+        capacity: int = 4096,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.interval_s = max(0.01, float(interval_s))
+        self.capacity = max(16, int(capacity))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._series: dict[str, deque[tuple[float, float]]] = {}
+        self._extra: dict[str, Callable[[], float]] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def add_series(self, name: str, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._extra[name] = fn
+
+    def remove_series(self, name: str) -> None:
+        """Stop sampling ``name``; already-sampled points stay exportable
+        (a server shutting down must not erase the trace it just made)."""
+        with self._lock:
+            self._extra.pop(name, None)
+
+    def _append(self, name: str, t: float, value: float) -> None:
+        ring = self._series.get(name)
+        if ring is None:
+            ring = self._series.setdefault(
+                name, deque(maxlen=self.capacity)
+            )
+        ring.append((t, float(value)))
+
+    def sample_once(self) -> None:
+        """Take one sample of every series now (also what the thread does
+        each tick) — tests and short-lived harnesses call this directly
+        instead of spinning the thread."""
+        t = self._clock()
+        try:
+            import jax
+
+            devices = list(jax.devices())
+        except Exception:
+            devices = []
+        with self._lock:
+            for d in devices:
+                stats = _device_mem_stats(d)
+                if "bytes_in_use" in stats:
+                    self._append(
+                        f"device_mem_bytes:{d}", t, stats["bytes_in_use"]
+                    )
+            rss = host_rss_bytes()
+            if rss is not None:
+                self._append("host_rss_bytes", t, rss)
+            for name, fn in list(self._extra.items()):
+                try:
+                    v = float(fn())
+                except Exception:
+                    continue
+                self._append(name, t, v)
+
+    def series(self) -> dict[str, list[tuple[float, float]]]:
+        """Snapshot of every sampled series (name -> [(t_s, value), ...])."""
+        with self._lock:
+            return {k: list(v) for k, v in self._series.items() if v}
+
+    def start(self) -> "DeviceSampler":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
+        def _run() -> None:
+            while not self._stop.wait(self.interval_s):
+                self.sample_once()
+
+        self._thread = threading.Thread(
+            target=_run, name="cobalt-device-sampler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def __enter__(self) -> "DeviceSampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+_default_lock = threading.Lock()
+_default: DeviceSampler | None = None
+
+
+def default_device_sampler() -> DeviceSampler:
+    """The process-wide sampler (lazily created, NOT auto-started)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = DeviceSampler()
+        return _default

@@ -74,8 +74,18 @@ META_ROUTES: frozenset[str] = frozenset(
         "/debug/requests",
         "/debug/slowest",
         "/debug/trace",
+        "/debug/programs",
     }
 )
+
+
+def _phase_filter(recs: list[dict], phase: str | None) -> list[dict]:
+    """Keep only records that spent time in ``phase`` (a key of their
+    ``phases_ms`` breakdown) — the ``?phase=`` query of the debug routes,
+    so a queue-wait hunt doesn't page through validate-only requests."""
+    if phase is None:
+        return recs
+    return [r for r in recs if phase in r.get("phases_ms", {})]
 
 
 class PhaseAccumulator:
@@ -201,24 +211,27 @@ class FlightRecorder:
                 heapq.heapreplace(self._slow_heap, entry)
         return rec
 
-    def records(self, limit: int = 50) -> list[dict]:
-        """Most recent records, newest first."""
+    def records(self, limit: int = 50, phase: str | None = None) -> list[dict]:
+        """Most recent records, newest first; ``phase`` keeps only records
+        that spent time in that phase."""
         with self._lock:
             recs = list(self._recent)
-        return recs[::-1][: max(0, int(limit))]
+        return _phase_filter(recs[::-1], phase)[: max(0, int(limit))]
 
-    def errors(self, limit: int = 50) -> list[dict]:
+    def errors(self, limit: int = 50, phase: str | None = None) -> list[dict]:
         """Most recent non-2xx records, newest first."""
         with self._lock:
             recs = list(self._errors)
-        return recs[::-1][: max(0, int(limit))]
+        return _phase_filter(recs[::-1], phase)[: max(0, int(limit))]
 
-    def slowest(self, k: int | None = None) -> list[dict]:
+    def slowest(
+        self, k: int | None = None, phase: str | None = None
+    ) -> list[dict]:
         """Top-``k`` records by wall time ever recorded, slowest first."""
         with self._lock:
             board = sorted(self._slow_heap, reverse=True)
         k = self.top_k if k is None else max(0, int(k))
-        return [rec for _, _, rec in board[:k]]
+        return _phase_filter([rec for _, _, rec in board], phase)[:k]
 
     def stats(self) -> dict:
         with self._lock:

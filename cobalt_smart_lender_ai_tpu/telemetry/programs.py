@@ -1,0 +1,414 @@
+"""Process-wide registry of compiled XLA programs and their measured cost.
+
+PERF_ATTRIBUTION.md reconstructed "where does 1.27 s/tree go?" by hand —
+ablation scripts and optimized-HLO inspection — because nothing in the
+framework could say which compiled program a second of wall time belonged
+to. This module is the standing answer: every site that compiles an
+executable (the serving partitioner's structure-keyed cache, the CV
+fan-out runners in `parallel/tune.py`, `serve/service.py`'s per-bucket
+programs) registers it here under a stable human-readable name, and every
+dispatch through it reports wall seconds back. The registry derives
+achieved FLOP/s and a roofline-utilization estimate when the backend's
+`cost_analysis()` cooperates, and degrades to plain dispatch accounting
+when it does not (CPU returns nothing useful; some backends raise).
+
+Three consumers read the same table:
+
+- ``GET /debug/programs`` on both HTTP adapters (live serving view);
+- ``cobalt_program_*`` metric families, published into any
+  `MetricsRegistry` via `install_program_metrics` (collect-time
+  callbacks — zero bookkeeping on the dispatch path beyond two adds);
+- `telemetry.runledger.RunLedger`, which snapshots the table into the
+  per-run JSON artifact that `tools/obs_report.py` renders and diffs.
+
+Everything is stdlib-only and thread-safe; dispatch recording is two
+float adds under a per-program lock.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+__all__ = [
+    "ProgramHandle",
+    "ProgramRegistry",
+    "default_program_registry",
+    "install_program_metrics",
+    "peak_flops_estimate",
+    "program_table",
+    "set_default_program_registry",
+]
+
+#: Very coarse per-chip peak dense-FLOP/s by device kind (bf16/fp32 mixed
+#: numbers from public spec sheets) — only used to derive the roofline
+#: utilization *estimate*. Unknown kinds (every CPU) map to None and the
+#: estimate is simply omitted; nothing downstream requires it.
+_PEAK_FLOPS_BY_KIND: tuple[tuple[str, float], ...] = (
+    ("tpu v5p", 459e12),
+    ("tpu v5", 197e12),
+    ("tpu v4", 275e12),
+    ("tpu v3", 123e12),
+    ("tpu v2", 46e12),
+)
+
+
+def peak_flops_estimate(device_kind: str | None) -> float | None:
+    """Peak FLOP/s for a device kind, or None when unknown (CPU, new TPUs
+    not in the table) — callers must treat None as "no roofline"."""
+    if not device_kind:
+        return None
+    kind = device_kind.lower()
+    for prefix, peak in _PEAK_FLOPS_BY_KIND:
+        if kind.startswith(prefix):
+            return peak
+    return None
+
+
+def cost_analysis_estimates(compiled: Any) -> dict[str, float]:
+    """FLOPs / bytes-accessed estimates from a compiled executable's
+    `cost_analysis()`, guarded for every observed backend shape: a dict, a
+    per-device list of dicts, None/empty, or an outright raise (CPU and
+    tunneled backends all happen). Returns a possibly-empty dict with keys
+    drawn from ``{"flops", "bytes_accessed"}``."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not isinstance(cost, Mapping):
+        return {}
+    out: dict[str, float] = {}
+    for ours, theirs in (("flops", "flops"), ("bytes_accessed", "bytes accessed")):
+        try:
+            v = float(cost.get(theirs, float("nan")))
+        except Exception:
+            continue
+        if math.isfinite(v) and v > 0:
+            out[ours] = v
+    return out
+
+
+class ProgramHandle:
+    """Accounting cell for one named program. Cheap to hold; dispatch sites
+    keep a reference and call `record_dispatch` (or wrap their callable via
+    `wrap`) on the hot path."""
+
+    __slots__ = (
+        "name", "kind", "meta", "_lock",
+        "compiles", "compile_seconds", "flops", "bytes_accessed",
+        "dispatches", "dispatch_seconds", "rows",
+    )
+
+    def __init__(self, name: str, kind: str, meta: dict[str, Any]):
+        self.name = name
+        self.kind = kind
+        self.meta = meta
+        self._lock = threading.Lock()
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.flops: float | None = None
+        self.bytes_accessed: float | None = None
+        self.dispatches = 0
+        self.dispatch_seconds = 0.0
+        self.rows = 0
+
+    def record_compile(
+        self, seconds: float, compiled: Any | None = None
+    ) -> None:
+        """One actual (cache-missing) compile of this program's executable;
+        ``compiled`` (when given) feeds the guarded cost estimates."""
+        with self._lock:
+            self.compiles += 1
+            self.compile_seconds += max(0.0, float(seconds))
+        if compiled is not None:
+            self.ensure_cost(compiled)
+
+    def ensure_cost(self, compiled: Any) -> None:
+        """Fill the FLOPs/bytes estimates from an executable handle if we
+        do not have them yet (cache hits re-offer the handle, first wins)."""
+        if self.flops is not None and self.bytes_accessed is not None:
+            return
+        est = cost_analysis_estimates(compiled)
+        with self._lock:
+            if self.flops is None and "flops" in est:
+                self.flops = est["flops"]
+            if self.bytes_accessed is None and "bytes_accessed" in est:
+                self.bytes_accessed = est["bytes_accessed"]
+
+    def record_dispatch(
+        self, seconds: float, *, count: int = 1, rows: int = 0
+    ) -> None:
+        with self._lock:
+            self.dispatches += int(count)
+            self.dispatch_seconds += max(0.0, float(seconds))
+            self.rows += int(rows)
+
+    def wrap(self, fn: Callable, *, block: bool = True) -> Callable:
+        """Wrap a dispatch callable so every call records wall seconds
+        here. ``block=True`` waits for the result buffers (guarded — the
+        output is returned untouched either way), so the recorded wall is
+        execution, not async enqueue."""
+
+        def dispatched(*args, **kwargs):
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            if block:
+                try:
+                    import jax
+
+                    jax.block_until_ready(out)
+                except Exception:
+                    pass
+            self.record_dispatch(time.perf_counter() - t0)
+            return out
+
+        dispatched.__wrapped__ = fn  # tests / introspection
+        return dispatched
+
+    def snapshot(self) -> dict[str, Any]:
+        """One JSON-able table row with the derived rates."""
+        with self._lock:
+            row: dict[str, Any] = {
+                "name": self.name,
+                "kind": self.kind,
+                "compiles": self.compiles,
+                "compile_seconds": round(self.compile_seconds, 6),
+                "flops": self.flops,
+                "bytes_accessed": self.bytes_accessed,
+                "dispatches": self.dispatches,
+                "dispatch_seconds": round(self.dispatch_seconds, 6),
+                "rows": self.rows,
+            }
+            disp_s = self.dispatch_seconds
+            flops = self.flops
+        row.update(self.meta)
+        achieved = None
+        if flops and disp_s > 0 and row["dispatches"] > 0:
+            achieved = flops * row["dispatches"] / disp_s
+        row["achieved_flops_per_second"] = achieved
+        peak = peak_flops_estimate(row.get("device_kind"))
+        row["roofline_utilization"] = (
+            None if achieved is None or not peak else achieved / peak
+        )
+        return row
+
+
+class ProgramRegistry:
+    """Name-keyed collection of `ProgramHandle`s plus the metric-family
+    publication machinery. One process-wide instance
+    (`default_program_registry`) is shared by training and serving — the
+    partitioner's executable cache is process-global, so the program table
+    is too."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._programs: dict[str, ProgramHandle] = {}
+        # (metrics_registry, replica_label, device_filter) sinks; every new
+        # program is wired into each existing sink and vice versa.
+        self._sinks: list[tuple[Any, str | None, str | None]] = []
+
+    def register(
+        self,
+        name: str,
+        *,
+        kind: str = "",
+        meta: Mapping[str, Any] | None = None,
+    ) -> ProgramHandle:
+        """Get-or-create the program named ``name``. Re-registration (every
+        cache hit re-registers) returns the existing handle unchanged."""
+        with self._lock:
+            prog = self._programs.get(name)
+            if prog is None:
+                prog = ProgramHandle(name, kind, dict(meta or {}))
+                self._programs[name] = prog
+                sinks = list(self._sinks)
+            else:
+                return prog
+        for reg, replica, device in sinks:
+            self._wire(reg, prog, replica, device)
+        return prog
+
+    def get(self, name: str) -> ProgramHandle | None:
+        with self._lock:
+            return self._programs.get(name)
+
+    def table(self, *, kind: str | None = None) -> list[dict[str, Any]]:
+        """All program rows, most dispatch-expensive first — the payload of
+        ``GET /debug/programs`` and the ledger's ``programs`` block."""
+        with self._lock:
+            progs = list(self._programs.values())
+        rows = [p.snapshot() for p in progs]
+        if kind is not None:
+            rows = [r for r in rows if r["kind"] == kind]
+        rows.sort(key=lambda r: (-r["dispatch_seconds"], r["name"]))
+        return rows
+
+    def totals(self) -> dict[str, float]:
+        rows = self.table()
+        return {
+            "programs": len(rows),
+            "compiles": sum(r["compiles"] for r in rows),
+            "compile_seconds": round(
+                sum(r["compile_seconds"] for r in rows), 6
+            ),
+            "dispatches": sum(r["dispatches"] for r in rows),
+            "dispatch_seconds": round(
+                sum(r["dispatch_seconds"] for r in rows), 6
+            ),
+        }
+
+    def reset(self) -> None:
+        """Drop every program AND sink — test isolation only."""
+        with self._lock:
+            self._programs.clear()
+            self._sinks.clear()
+
+    # -- metric publication ---------------------------------------------------
+
+    def publish(
+        self,
+        metrics_registry: Any,
+        *,
+        replica: str | None = None,
+        device: str | None = None,
+    ) -> None:
+        """Export the table as ``cobalt_program_*`` families on
+        ``metrics_registry`` via collect-time callbacks. ``replica`` adds a
+        ``replica`` label (the fleet facade publishes each replica's view
+        this way); ``device`` filters to programs whose ``device`` meta
+        matches (a pinned replica only reports its own programs).
+        Idempotent per (registry, replica): re-publication rewires the same
+        callbacks."""
+        with self._lock:
+            sink = (metrics_registry, replica, device)
+            self._sinks = [
+                s
+                for s in self._sinks
+                if not (s[0] is metrics_registry and s[1] == replica)
+            ]
+            self._sinks.append(sink)
+            progs = list(self._programs.values())
+        for prog in progs:
+            self._wire(metrics_registry, prog, replica, device)
+
+    def _wire(
+        self,
+        reg: Any,
+        prog: ProgramHandle,
+        replica: str | None,
+        device: str | None,
+    ) -> None:
+        if device is not None and prog.meta.get("device") != device:
+            return
+        labelnames = ("program",) if replica is None else ("program", "replica")
+
+        def child(family):
+            if replica is None:
+                return family.labels(program=prog.name)
+            return family.labels(program=prog.name, replica=replica)
+
+        child(
+            reg.counter(
+                "cobalt_program_dispatches_total",
+                "dispatches through each named compiled program",
+                labelnames,
+            )
+        ).set_function(lambda p=prog: p.dispatches)
+        child(
+            reg.counter(
+                "cobalt_program_dispatch_seconds_total",
+                "cumulative wall seconds executing each named program",
+                labelnames,
+            )
+        ).set_function(lambda p=prog: p.dispatch_seconds)
+        child(
+            reg.counter(
+                "cobalt_program_compile_seconds_total",
+                "cumulative wall seconds compiling each named program",
+                labelnames,
+            )
+        ).set_function(lambda p=prog: p.compile_seconds)
+        child(
+            reg.gauge(
+                "cobalt_program_flops",
+                "XLA cost_analysis FLOPs estimate per dispatch of each "
+                "program (NaN where the backend reports nothing)",
+                labelnames,
+            )
+        ).set_function(
+            lambda p=prog: float("nan") if p.flops is None else p.flops
+        )
+        child(
+            reg.gauge(
+                "cobalt_program_bytes_accessed",
+                "XLA cost_analysis bytes-accessed estimate per dispatch "
+                "(NaN where the backend reports nothing)",
+                labelnames,
+            )
+        ).set_function(
+            lambda p=prog: float("nan")
+            if p.bytes_accessed is None
+            else p.bytes_accessed
+        )
+
+        def _achieved(p=prog):
+            with p._lock:
+                if not p.flops or p.dispatch_seconds <= 0 or not p.dispatches:
+                    return float("nan")
+                return p.flops * p.dispatches / p.dispatch_seconds
+
+        child(
+            reg.gauge(
+                "cobalt_program_achieved_flops_per_second",
+                "achieved FLOP/s through each program (cost_analysis FLOPs "
+                "x dispatches / measured dispatch seconds; NaN until both "
+                "sides exist)",
+                labelnames,
+            )
+        ).set_function(_achieved)
+
+
+_default_lock = threading.Lock()
+_default: ProgramRegistry | None = None
+
+
+def default_program_registry() -> ProgramRegistry:
+    """The process-wide program registry (lazily created)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = ProgramRegistry()
+        return _default
+
+
+def set_default_program_registry(reg: ProgramRegistry) -> ProgramRegistry:
+    """Swap the process default (tests); returns the previous one."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = ProgramRegistry()
+        prev = _default
+        _default = reg
+    return prev
+
+
+def program_table(*, kind: str | None = None) -> list[dict[str, Any]]:
+    """The default registry's table — ``GET /debug/programs``' payload."""
+    return default_program_registry().table(kind=kind)
+
+
+def install_program_metrics(metrics_registry: Any | None = None) -> None:
+    """Publish ``cobalt_program_*`` onto ``metrics_registry`` (default: the
+    process-wide `telemetry.metrics.default_registry()`, resolved at call
+    time so tests that swap it publish onto the fresh one)."""
+    if metrics_registry is None:
+        from cobalt_smart_lender_ai_tpu.telemetry.metrics import (
+            default_registry,
+        )
+
+        metrics_registry = default_registry()
+    default_program_registry().publish(metrics_registry)

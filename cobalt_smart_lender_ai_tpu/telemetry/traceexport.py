@@ -25,14 +25,18 @@ Format notes (Trace Event Format, "JSON Object Format" flavor):
   attrs, so a flight record's ``trace_id`` is searchable in the Perfetto
   query box and events join back to log lines;
 - one metadata event (``"ph": "M"``, ``thread_name``) per thread names the
-  tracks.
+  tracks;
+- sampled series from `telemetry.devices.DeviceSampler` (queue depth,
+  device memory, host RSS) become **counter tracks** (``"ph": "C"``) —
+  Perfetto draws them as area charts on the same timeline, sharing the
+  spans' monotonic clock origin.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any
+from typing import Any, Mapping, Sequence
 
 from cobalt_smart_lender_ai_tpu.telemetry.tracing import (
     Tracer,
@@ -46,10 +50,24 @@ TRACE_CONTENT_TYPE = "application/json"
 
 
 def chrome_trace(
-    tracer: Tracer | None = None, *, limit: int | None = None
+    tracer: Tracer | None = None,
+    *,
+    limit: int | None = None,
+    counters: Mapping[str, Sequence[tuple[float, float]]] | None = None,
 ) -> dict[str, Any]:
-    """JSON-able Chrome Trace Event document for the tracer's span ring."""
+    """JSON-able Chrome Trace Event document for the tracer's span ring.
+
+    ``counters`` maps series name -> [(t_monotonic_s, value), ...]; None
+    pulls whatever `telemetry.devices.default_device_sampler` has sampled
+    (empty unless something started/ticked it — exporting never spawns a
+    thread)."""
     spans = (tracer or default_tracer()).export(limit=limit)
+    if counters is None:
+        from cobalt_smart_lender_ai_tpu.telemetry.devices import (
+            default_device_sampler,
+        )
+
+        counters = default_device_sampler().series()
     pid = os.getpid()
     events: list[dict[str, Any]] = []
     seen_threads: dict[int, str] = {}
@@ -87,19 +105,37 @@ def chrome_trace(
                 "args": {"name": tname},
             }
         )
+    counter_count = 0
+    for name in sorted(counters or {}):
+        for t, value in counters[name]:
+            events.append(
+                {
+                    "name": name,
+                    "cat": "counter",
+                    "ph": "C",
+                    "ts": round(float(t) * 1e6, 3),
+                    "pid": pid,
+                    "args": {"value": float(value)},
+                }
+            )
+            counter_count += 1
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
         "otherData": {
             "source": "cobalt_smart_lender_ai_tpu.telemetry",
             "span_count": sum(1 for e in events if e.get("ph") == "X"),
+            "counter_event_count": counter_count,
         },
     }
 
 
 def render_chrome_trace(
-    tracer: Tracer | None = None, *, limit: int | None = None
+    tracer: Tracer | None = None,
+    *,
+    limit: int | None = None,
+    counters: Mapping[str, Sequence[tuple[float, float]]] | None = None,
 ) -> str:
     """`chrome_trace` serialized — what ``GET /debug/trace`` sends and
     ``bench_serve.py --trace-out`` writes."""
-    return json.dumps(chrome_trace(tracer, limit=limit))
+    return json.dumps(chrome_trace(tracer, limit=limit, counters=counters))

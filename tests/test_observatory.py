@@ -1,0 +1,403 @@
+"""Performance-observatory tests: program cost attribution on the forced
+8-device mesh, graceful cost_analysis degradation, run-ledger round-trips
+plus obs_report rendering/diffing, Perfetto counter tracks, and the debug
+routes' limit/phase query validation."""
+
+import json
+import math
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cobalt_smart_lender_ai_tpu.telemetry.programs import (
+    ProgramRegistry,
+    cost_analysis_estimates,
+    default_program_registry,
+    peak_flops_estimate,
+    set_default_program_registry,
+)
+
+
+@pytest.fixture()
+def fresh_programs():
+    """Swap in an empty process program registry; restore the old one."""
+    reg = ProgramRegistry()
+    prev = set_default_program_registry(reg)
+    yield reg
+    set_default_program_registry(prev)
+
+
+# --- cost_analysis guarding ---------------------------------------------------
+
+
+class _RaisingCompiled:
+    def cost_analysis(self):
+        raise RuntimeError("backend does not implement cost analysis")
+
+
+class _NoneCompiled:
+    def cost_analysis(self):
+        return None
+
+
+class _ListCompiled:
+    def cost_analysis(self):
+        return [{"flops": 12.5, "bytes accessed": 300.0}]
+
+
+class _DictCompiled:
+    def cost_analysis(self):
+        return {"flops": 7.0, "bytes accessed": float("nan"), "other": 1}
+
+
+def test_cost_analysis_estimates_guards_every_backend_shape():
+    assert cost_analysis_estimates(_RaisingCompiled()) == {}
+    assert cost_analysis_estimates(_NoneCompiled()) == {}
+    assert cost_analysis_estimates(object()) == {}  # no method at all
+    est = cost_analysis_estimates(_ListCompiled())
+    assert est == {"flops": 12.5, "bytes_accessed": 300.0}
+    # NaN / non-positive values are dropped, valid keys kept
+    assert cost_analysis_estimates(_DictCompiled()) == {"flops": 7.0}
+
+
+def test_program_handle_degrades_without_cost(fresh_programs):
+    prog = fresh_programs.register("x", kind="test")
+    prog.record_compile(0.5, _RaisingCompiled())
+    prog.record_dispatch(0.25, count=2)
+    row = prog.snapshot()
+    assert row["flops"] is None
+    assert row["achieved_flops_per_second"] is None
+    assert row["roofline_utilization"] is None
+    assert row["dispatches"] == 2 and row["dispatch_seconds"] == 0.25
+
+
+def test_roofline_only_for_known_kinds(fresh_programs):
+    assert peak_flops_estimate("TPU v4") == 275e12
+    assert peak_flops_estimate("cpu") is None
+    assert peak_flops_estimate(None) is None
+    prog = fresh_programs.register(
+        "y", kind="test", meta={"device_kind": "TPU v4"}
+    )
+    prog.record_compile(0.0, _ListCompiled())
+    prog.record_dispatch(0.5)
+    row = prog.snapshot()
+    assert row["achieved_flops_per_second"] == pytest.approx(12.5 / 0.5)
+    assert row["roofline_utilization"] == pytest.approx(25.0 / 275e12)
+
+
+# --- capture on the forced multi-device mesh ---------------------------------
+
+
+def test_mesh_partitioner_programs_captured(fresh_programs):
+    import jax
+    import jax.numpy as jnp
+
+    from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
+    from cobalt_smart_lender_ai_tpu.parallel.partitioner import (
+        make_partitioner,
+    )
+
+    assert jax.device_count() == 8  # conftest forces the virtual mesh
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(128, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float32)
+    model = GBDTClassifier(n_estimators=4, max_depth=2, n_bins=16)
+    model.fit(X, y)
+
+    part = make_partitioner(-1)
+    assert part.n_shards == 8
+    fn = part.compile_margin(model.forest, X.shape[1], 128)
+    out = fn(jnp.asarray(X))
+    assert np.asarray(out).shape == (128,)
+
+    table = fresh_programs.table()
+    row = next(r for r in table if r["name"].startswith("serve.mesh_margin"))
+    assert row["shards"] == 8
+    assert row["compiles"] == 1 and row["compile_seconds"] > 0
+    assert row["dispatches"] == 1 and row["dispatch_seconds"] > 0
+
+    # Cache hit: no second compile, but dispatches keep accumulating.
+    fn2 = part.compile_margin(model.forest, X.shape[1], 128)
+    fn2(jnp.asarray(X))
+    row = fresh_programs.get(row["name"]).snapshot()
+    assert row["compiles"] == 1 and row["dispatches"] == 2
+
+    totals = fresh_programs.totals()
+    assert totals["dispatch_seconds"] >= row["dispatch_seconds"]
+
+
+def test_program_metrics_families_publish(fresh_programs):
+    from cobalt_smart_lender_ai_tpu.telemetry.metrics import MetricsRegistry
+
+    prog = fresh_programs.register("serve.fake[rows=1]", kind="serve")
+    reg = MetricsRegistry()
+    fresh_programs.publish(reg)
+    prog.record_dispatch(0.75, count=3)
+    # A program registered AFTER publish is wired into the existing sink.
+    late = fresh_programs.register("serve.late[rows=2]", kind="serve")
+    late.record_dispatch(0.25)
+    snap = reg.snapshot()
+    fam = snap["cobalt_program_dispatch_seconds_total"]
+    by_label = {
+        s["labels"]["program"]: s["value"] for s in fam["samples"]
+    }
+    assert by_label["serve.fake[rows=1]"] == pytest.approx(0.75)
+    assert by_label["serve.late[rows=2]"] == pytest.approx(0.25)
+    # Unknown cost estimates render as NaN, not a missing family.
+    flops = snap["cobalt_program_flops"]["samples"]
+    assert all(math.isnan(s["value"]) for s in flops)
+
+
+# --- debug routes: /debug/programs + limit/phase validation ------------------
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+@pytest.fixture()
+def observatory_server(serving_artifact, fresh_programs):
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, X = serving_artifact
+    svc = ScorerService.from_store(
+        store,
+        ServeConfig(precompile_batch_buckets=(), microbatch_enabled=False),
+    )
+    httpd = make_server(svc, "127.0.0.1", 0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", svc, X
+    httpd.shutdown()
+    httpd.server_close()
+    svc.close()
+
+
+def test_debug_programs_and_metrics_live_on_serving(observatory_server):
+    base, svc, X = observatory_server
+    from cobalt_smart_lender_ai_tpu.data import schema
+
+    payload = {
+        name: float(v)
+        for name, v in zip(schema.SERVING_FEATURES, np.asarray(X[0]))
+    }
+    req = urllib.request.Request(
+        base + "/predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.status == 200
+
+    status, body = _get(base + "/debug/programs")
+    assert status == 200
+    rows = {r["name"]: r for r in body["programs"]}
+    dispatched = [r for r in rows.values() if r["dispatches"] > 0]
+    assert dispatched and all(
+        r["dispatch_seconds"] > 0 for r in dispatched
+    )
+    assert any(name.startswith("serve.margin") for name in rows)
+    assert body["totals"]["dispatch_seconds"] > 0
+
+    # The SAME table rides the service's Prometheus scrape.
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+        text = resp.read().decode()
+    assert "cobalt_program_dispatch_seconds_total" in text
+    assert "cobalt_device_mem_bytes" in text
+    assert "cobalt_host_rss_bytes" in text
+
+
+def test_debug_limit_and_phase_validation(observatory_server):
+    base, _, _ = observatory_server
+    status, body = _get(base + "/debug/requests?limit=5")
+    assert status == 200
+    status, body = _get(base + "/debug/requests?limit=0")
+    assert status == 422
+    assert "limit" in body["detail"]
+    status, body = _get(base + "/debug/slowest?limit=2000")
+    assert status == 422
+    status, body = _get(base + "/debug/requests?phase=bogus")
+    assert status == 422
+    assert "phase" in body["detail"]
+    status, body = _get(base + "/debug/slowest?k=3&phase=dispatch")
+    assert status == 200
+    assert all(
+        "dispatch" in r["phases_ms"] for r in body["slowest"]
+    )
+    # Legacy n= alias still works alongside limit=.
+    status, body = _get(base + "/debug/requests?n=2")
+    assert status == 200
+    assert len(body["recent"]) <= 2
+
+
+# --- run ledger + obs_report -------------------------------------------------
+
+
+def _fake_ledger(tmp_path, name, *, search_secs, auc, fresh_reg):
+    from cobalt_smart_lender_ai_tpu.telemetry.metrics import MetricsRegistry
+    from cobalt_smart_lender_ai_tpu.telemetry.runledger import RunLedger
+
+    mreg = MetricsRegistry()
+    mreg.counter(
+        "cobalt_search_dispatch_seconds",
+        "measured search dispatch wall",
+        ("mode",),
+    ).labels(mode="halving").inc(search_secs)
+    prog = fresh_reg.register(
+        "search.cv_runner[mode=halving,depth=5,chunk=10,bins=64]",
+        kind="search",
+    )
+    prog.record_dispatch(search_secs * 0.95, count=4)
+
+    ledger = RunLedger("pipeline", fingerprint="fp-abc", meta={"quick": True})
+    ledger.add_stage("search", search_secs)
+    ledger.add_stage("eval", 0.5)
+    ledger.set("final_metrics", {"test_auc": auc, "cv_auc": auc - 0.01})
+    path = str(tmp_path / name)
+    doc = ledger.write(path, registry=mreg)
+    return path, doc
+
+
+def test_ledger_roundtrip_and_attribution(tmp_path, fresh_programs):
+    from cobalt_smart_lender_ai_tpu.telemetry.runledger import load_ledger
+
+    path, doc = _fake_ledger(
+        tmp_path, "a.json", search_secs=2.0, auc=0.79,
+        fresh_reg=fresh_programs,
+    )
+    loaded = load_ledger(path)
+    assert loaded["schema"] == doc["schema"] == 1
+    assert loaded["fingerprint"] == "fp-abc"
+    assert loaded["stages"]["search"] == pytest.approx(2.0)
+    attr = loaded["dispatch_attribution"]
+    assert attr["measured_seconds"] == pytest.approx(2.0)
+    assert attr["ratio"] == pytest.approx(0.95)
+    assert loaded["env"]["device_count"] == 8
+    names = [p["name"] for p in loaded["programs"]]
+    assert "search.cv_runner[mode=halving,depth=5,chunk=10,bins=64]" in names
+
+    bad = tmp_path / "not_a_ledger.json"
+    bad.write_text("{}")
+    with pytest.raises(ValueError):
+        load_ledger(str(bad))
+
+
+def test_obs_report_render_and_diff(tmp_path, fresh_programs, capsys):
+    from tools.obs_report import main as report_main
+    from tools.obs_report import render_diff, render_report
+
+    path_a, doc_a = _fake_ledger(
+        tmp_path, "a.json", search_secs=2.0, auc=0.79,
+        fresh_reg=fresh_programs,
+    )
+    path_b, doc_b = _fake_ledger(
+        tmp_path, "b.json", search_secs=1.0, auc=0.80,
+        fresh_reg=fresh_programs,
+    )
+
+    report = render_report(doc_a)
+    assert "# Run report: pipeline" in report
+    assert "search.cv_runner[mode=halving,depth=5,chunk=10,bins=64]" in report
+    assert "ratio: 0.95" in report
+    assert "test_auc: 0.79" in report
+
+    diff = render_diff(doc_a, doc_b)
+    assert "Stage deltas" in diff
+    assert "search" in diff and "test_auc" in diff
+
+    # CLI: render passes the 0.8 attribution gate, writes --out.
+    out = tmp_path / "REPORT.md"
+    rc = report_main([path_a, "--out", str(out), "--min-attribution", "0.8"])
+    assert rc == 0
+    assert "# Run report" in out.read_text()
+    # Diff mode via positional second ledger.
+    rc = report_main([path_a, path_b])
+    assert rc == 0
+    assert "Run diff" in capsys.readouterr().out
+
+    # Gate failure: attribute far less than measured.
+    from cobalt_smart_lender_ai_tpu.telemetry.metrics import MetricsRegistry
+    from cobalt_smart_lender_ai_tpu.telemetry.runledger import RunLedger
+
+    fresh_programs.reset()
+    fresh_programs.register("search.tiny", kind="search").record_dispatch(0.1)
+    mreg = MetricsRegistry()
+    mreg.counter(
+        "cobalt_search_dispatch_seconds", "measured wall", ("mode",)
+    ).labels(mode="halving").inc(2.0)
+    path_c = str(tmp_path / "c.json")
+    RunLedger("pipeline").write(path_c, registry=mreg)
+    rc = report_main([path_c, "--min-attribution", "0.8"])
+    assert rc == 1
+
+
+# --- device sampler + Perfetto counter tracks --------------------------------
+
+
+def test_device_sampler_series_and_extra_callbacks():
+    from cobalt_smart_lender_ai_tpu.telemetry.devices import DeviceSampler
+
+    t = [100.0]
+    sampler = DeviceSampler(clock=lambda: t[0])
+    depth = [3.0]
+    sampler.add_series("queue_depth", lambda: depth[0])
+    sampler.add_series("broken", lambda: 1 / 0)  # raises: skipped, not fatal
+    sampler.sample_once()
+    t[0] = 101.0
+    depth[0] = 5.0
+    sampler.sample_once()
+    series = sampler.series()
+    assert series["queue_depth"] == [(100.0, 3.0), (101.0, 5.0)]
+    assert "broken" not in series
+    assert "host_rss_bytes" in series  # built-in, Linux-readable in CI
+    # Removing a series stops sampling but keeps already-sampled points.
+    sampler.remove_series("queue_depth")
+    t[0] = 102.0
+    sampler.sample_once()
+    assert sampler.series()["queue_depth"][-1] == (101.0, 5.0)
+
+
+def test_chrome_trace_counter_tracks_valid():
+    from cobalt_smart_lender_ai_tpu.telemetry.traceexport import chrome_trace
+
+    counters = {
+        "queue_depth": [(1.0, 2.0), (1.5, 4.0)],
+        "device_mem_bytes:cpu:0": [(1.25, 1024.0)],
+    }
+    doc = chrome_trace(counters=counters)
+    events = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert len(events) == 3
+    for e in events:
+        assert e["cat"] == "counter"
+        assert isinstance(e["ts"], float) and e["ts"] > 0
+        assert isinstance(e["args"]["value"], float)
+    qd = [e for e in events if e["name"] == "queue_depth"]
+    assert [e["args"]["value"] for e in qd] == [2.0, 4.0]
+    assert qd[0]["ts"] == pytest.approx(1.0e6)
+    assert doc["otherData"]["counter_event_count"] == 3
+    # The whole document must stay JSON-serializable (the export contract).
+    json.dumps(doc)
+
+
+def test_host_rss_and_device_info_shapes():
+    from cobalt_smart_lender_ai_tpu.telemetry.devices import (
+        device_info,
+        host_rss_bytes,
+    )
+
+    rss = host_rss_bytes()
+    assert rss is None or rss > 0
+    rows = device_info()
+    assert len(rows) == 8
+    assert {r["platform"] for r in rows} == {"cpu"}
+    assert all(isinstance(r["id"], int) for r in rows)

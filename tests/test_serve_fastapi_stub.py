@@ -220,6 +220,7 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
         "/debug/requests",
         "/debug/slowest",
         "/debug/trace",
+        "/debug/programs",
     }
 
     # health/readiness GET routes: healthy service -> ok, shap ok, 200 path
@@ -233,6 +234,10 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
     scrape = app.get_routes["/metrics"]()
     assert scrape.media_type.startswith("text/plain")
     parse_exposition(scrape.content)
+
+    # /debug/programs GET: the live program cost table payload
+    progs = app.get_routes["/debug/programs"]()
+    assert "programs" in progs and "totals" in progs
 
     # /predict happy path: the handler only needs model_dump(by_alias=True),
     # so a stand-in with the contract's two aliases drives it; the REAL

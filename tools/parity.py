@@ -244,12 +244,15 @@ def run_oracle(mats, seed: int = 22) -> dict:
 
 
 def run_head_to_head(
-    n_rows: int, seed: int = 11, chunk_trees: int | str | None = "auto"
+    n_rows: int,
+    seed: int = 11,
+    chunk_trees: int | str | None = "auto",
+    halving: bool = True,
 ):
     """Both sides in one process (used by the slow-marked test, where the
     conftest pins everything to the virtual CPU mesh)."""
     mats = build_matrices(n_rows, seed)
-    ours = run_ours(mats, chunk_trees=chunk_trees)
+    ours = run_ours(mats, chunk_trees=chunk_trees, halving=halving)
     oracle = run_oracle(mats)
     return merge(ours, oracle, n_rows=n_rows, seed=seed)
 
@@ -290,7 +293,39 @@ def main(argv=None):
         "rounds) instead of successive halving",
     )
     ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--trace-out",
+        default=None,
+        help="write the run's spans (+ device counter tracks) as Perfetto "
+        "JSON to this path",
+    )
+    ap.add_argument(
+        "--ledger-out",
+        default=None,
+        help="write a run ledger (env, side timings, program cost table) "
+        "to this path; render with tools/obs_report.py",
+    )
     args = ap.parse_args(argv)
+
+    ledger = None
+    if args.ledger_out:
+        from cobalt_smart_lender_ai_tpu.telemetry import (
+            RunLedger,
+            install_device_metrics,
+            install_program_metrics,
+        )
+
+        install_program_metrics()
+        install_device_metrics()
+        ledger = RunLedger(
+            "parity",
+            meta={
+                "side": args.side,
+                "rows": args.rows,
+                "seed": args.seed,
+                "halving": not args.no_halving,
+            },
+        )
 
     if args.side in ("ours", "both"):
         from cobalt_smart_lender_ai_tpu.compilecache import (
@@ -334,6 +369,25 @@ def main(argv=None):
     if args.out:
         with open(args.out, "w") as f:
             json.dump(result, f, indent=2)
+    if ledger is not None:
+        for side in ("ours", "oracle"):
+            block = result.get(side) if args.side in ("both", "merge") else (
+                result if result.get("side") == side else None
+            )
+            if isinstance(block, dict):
+                for stage, secs in (block.get("seconds") or {}).items():
+                    if stage != "total":
+                        ledger.add_stage(f"{side}.{stage}", float(secs))
+        ledger.set("parity", result)
+        ledger.write(args.ledger_out)
+    if args.trace_out:
+        from cobalt_smart_lender_ai_tpu.telemetry import (
+            default_tracer,
+            render_chrome_trace,
+        )
+
+        with open(args.trace_out, "w") as f:
+            f.write(render_chrome_trace(default_tracer()))
     return result
 
 

@@ -225,12 +225,37 @@ def main(argv=None) -> None:
     ap.add_argument("--degrade", action="store_true",
                     help="label-shuffle the training set (gate-rejection "
                     "fixture for tests/CI; never use in production)")
+    ap.add_argument("--trace-out", default=None,
+                    help="write the run's spans (+ device counter tracks) "
+                    "as Perfetto JSON to this path")
+    ap.add_argument("--ledger-out", default=None,
+                    help="write a run ledger (env, durations, program cost "
+                    "table) to this path; render with tools/obs_report.py")
     args = ap.parse_args(argv)
 
     from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
     from cobalt_smart_lender_ai_tpu.io import ObjectStore
 
     bootstrap_compile_cache()
+    ledger = None
+    if args.ledger_out:
+        from cobalt_smart_lender_ai_tpu.telemetry import (
+            RunLedger,
+            install_device_metrics,
+            install_program_metrics,
+        )
+
+        install_program_metrics()
+        install_device_metrics()
+        ledger = RunLedger(
+            "retrain",
+            meta={
+                "rows": args.rows,
+                "seed": args.seed,
+                "model_name": args.model_name,
+                "degrade": bool(args.degrade),
+            },
+        )
     report = retrain_candidate(
         ObjectStore(args.store),
         rows=args.rows,
@@ -243,6 +268,18 @@ def main(argv=None) -> None:
         n_estimators=args.n_estimators,
         max_depth=args.max_depth,
     )
+    if ledger is not None:
+        ledger.add_stage("retrain", float(report.get("wall_s", 0.0)))
+        ledger.set("retrain_report", report)
+        ledger.write(args.ledger_out)
+    if args.trace_out:
+        from cobalt_smart_lender_ai_tpu.telemetry import (
+            default_tracer,
+            render_chrome_trace,
+        )
+
+        with open(args.trace_out, "w") as f:
+            f.write(render_chrome_trace(default_tracer()))
     print(json.dumps(report))
 
 
