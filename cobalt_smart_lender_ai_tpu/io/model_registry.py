@@ -177,6 +177,15 @@ class ModelRegistry:
         ptr = self.channel(name, channel)
         return None if ptr is None else ptr["key"]
 
+    def channel_record(self, name: str, channel: str) -> ModelVersion | None:
+        """Channel pointer -> the full immutable version record, provenance
+        included — what batch consumers (the portfolio scorer) stamp into
+        their reports. None when the channel is unset."""
+        ptr = self.channel(name, channel)
+        if ptr is None:
+            return None
+        return self.record(name, int(ptr["version"]))
+
     def verify(self, name: str, version: int) -> bool:
         """Does the stored npz still hash to the record's md5?"""
         mv = self.record(name, version)

@@ -32,6 +32,7 @@ import shutil
 from pathlib import Path
 from typing import Iterator
 
+import numpy as np
 import pandas as pd
 
 #: Suffix of content-addressed pointer objects (`write_pointer`).
@@ -121,6 +122,28 @@ class ObjectStore:
         from cobalt_smart_lender_ai_tpu.native import read_csv
 
         return read_csv(self.get_bytes(key), engine="auto")
+
+    def save_array(self, key: str, arr: np.ndarray) -> None:
+        """One ndarray as an ``.npy`` object (portfolio score vectors)."""
+        buf = _io.BytesIO()
+        np.save(buf, np.asarray(arr), allow_pickle=False)
+        self.put_bytes(key, buf.getvalue())
+
+    def load_array(self, key: str) -> np.ndarray:
+        return np.load(_io.BytesIO(self.get_bytes(key)), allow_pickle=False)
+
+    def save_arrays(self, key: str, arrays: dict) -> None:
+        """A dict of ndarrays as one uncompressed ``.npz`` object — the
+        chunk-artifact shape the portfolio scorer checkpoints (zip entries
+        carry zipfile's fixed 1980 default timestamp, so identical arrays
+        produce identical bytes and content pins stay stable)."""
+        buf = _io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+        self.put_bytes(key, buf.getvalue())
+
+    def load_arrays(self, key: str) -> dict:
+        z = np.load(_io.BytesIO(self.get_bytes(key)), allow_pickle=False)
+        return {k: z[k] for k in z.files}
 
     # -- content-addressed pointers (DVC-pointer capability, C2) --------------
     def write_pointer(self, key: str) -> dict:

@@ -95,18 +95,27 @@ def _exec_cache_put(key: tuple, compiled: Any) -> Any:
 
 
 def _program_for(
-    kind: str, *, rows: int, n_features: int, device: Any = None, shards: int = 1
+    kind: str,
+    *,
+    rows: int,
+    n_features: int,
+    device: Any = None,
+    shards: int = 1,
+    prefix: str = "serve",
 ):
     """ProgramRegistry handle for a serving program — the observatory's
     hook into this cache. The name is the stable shape key an operator
     reads off ``GET /debug/programs``; a pinned device lands in the name
-    (and ``device`` meta) so each replica's programs stay distinct rows."""
+    (and ``device`` meta) so each replica's programs stay distinct rows.
+    ``prefix`` separates workloads in the cost table: live serving compiles
+    under ``serve.*``, the offline portfolio scorer under ``portfolio.*`` —
+    same executables (the exec cache ignores the prefix), distinct rows."""
     meta: dict[str, Any] = {
         "rows_per_dispatch": rows,
         "features": n_features,
         "shards": shards,
     }
-    name = f"serve.{kind}[rows={rows},features={n_features}"
+    name = f"{prefix}.{kind}[rows={rows},features={n_features}"
     if shards > 1:
         name += f",shards={shards}"
     if device is not None:
@@ -119,7 +128,7 @@ def _program_for(
         except Exception:
             pass
     name += "]"
-    return default_program_registry().register(name, kind="serve", meta=meta)
+    return default_program_registry().register(name, kind=prefix, meta=meta)
 
 
 def match_partition_rule(
@@ -189,8 +198,9 @@ class SingleDevicePartitioner(Partitioner):
     engine places each shared-nothing replica's programs on its own device
     this way; None keeps JAX's default placement."""
 
-    def __init__(self, device: Any | None = None):
+    def __init__(self, device: Any | None = None, *, kind_prefix: str = "serve"):
         self._device = device
+        self._kind_prefix = kind_prefix
 
     @property
     def mesh(self) -> Mesh | None:
@@ -219,7 +229,11 @@ class SingleDevicePartitioner(Partitioner):
             _forest_fingerprint(forest),
         )
         prog = _program_for(
-            "margin", rows=rows, n_features=n_features, device=self._device
+            "margin",
+            rows=rows,
+            n_features=n_features,
+            device=self._device,
+            prefix=self._kind_prefix,
         )
         compiled = _exec_cache_get(key)
         if compiled is None:
@@ -245,7 +259,11 @@ class SingleDevicePartitioner(Partitioner):
             _forest_fingerprint(forest),
         )
         prog = _program_for(
-            "shap", rows=rows, n_features=n_features, device=self._device
+            "shap",
+            rows=rows,
+            n_features=n_features,
+            device=self._device,
+            prefix=self._kind_prefix,
         )
         compiled = _exec_cache_get(key)
         if compiled is None:
@@ -284,11 +302,13 @@ class MeshPartitioner(Partitioner):
         *,
         dp_axis: str = "dp",
         rules: Sequence[tuple[str, tuple[Any, ...]]] = DEFAULT_RULES,
+        kind_prefix: str = "serve",
     ):
         devs = list(devices) if devices is not None else list(jax.devices())
         if not devs:
             raise ValueError("MeshPartitioner needs at least one device")
         self._dp_axis = dp_axis
+        self._kind_prefix = kind_prefix
         self._mesh = Mesh(np.asarray(devs), (dp_axis,))
         self._rules = tuple(rules)
         self._forest_spec = match_partition_rule(rules, "forest", dp_axis)
@@ -323,6 +343,7 @@ class MeshPartitioner(Partitioner):
             rows=rows,
             n_features=n_features,
             shards=self.n_shards,
+            prefix=self._kind_prefix,
         )
         compiled = _exec_cache_get(key)
         if compiled is None:
@@ -363,6 +384,7 @@ class MeshPartitioner(Partitioner):
             rows=rows,
             n_features=n_features,
             shards=self.n_shards,
+            prefix=self._kind_prefix,
         )
         compiled = _exec_cache_get(key)
         if compiled is None:
@@ -400,16 +422,20 @@ def make_partitioner(
     *,
     device: Any | None = None,
     devices: Sequence[Any] | None = None,
+    kind_prefix: str = "serve",
 ) -> Partitioner:
     """Resolve a shard-count knob into a partitioner.
 
     ``bulk_shards``: 0 or 1 -> single device; -1 -> every visible device;
     N -> an N-way ``dp`` mesh (clamped to the visible device count — a
-    config asking for 8 shards on a 4-device host gets 4, not a crash)."""
+    config asking for 8 shards on a 4-device host gets 4, not a crash).
+    ``kind_prefix`` names the compiled programs' namespace in the cost
+    table (``serve`` for live traffic, ``portfolio`` for batch sweeps);
+    the executable cache is shared across prefixes."""
     if bulk_shards in (0, 1):
-        return SingleDevicePartitioner(device)
+        return SingleDevicePartitioner(device, kind_prefix=kind_prefix)
     devs = list(devices) if devices is not None else list(jax.devices())
     n = len(devs) if bulk_shards == -1 else min(bulk_shards, len(devs))
     if n <= 1:
-        return SingleDevicePartitioner(device)
-    return MeshPartitioner(devs[:n])
+        return SingleDevicePartitioner(device, kind_prefix=kind_prefix)
+    return MeshPartitioner(devs[:n], kind_prefix=kind_prefix)

@@ -18,8 +18,16 @@ Manifest format (``"format": 1``)::
       "fingerprint": "9f3a...",
       "outputs": ["dataset/2-intermediate/cleaned_02_tree.csv", ...],
       "pointers": {"<key>": {"key": ..., "md5": ..., "size": ...}, ...},
-      "extra": {...}
+      "extra": {...},
+      "progress": {...}          # optional: partial-stage position
     }
+
+Whole-stage manifests (the pipeline) never emit ``progress``; long streaming
+stages (the portfolio scorer) call `advance` after every chunk so a kill can
+resume mid-stage — the payload carries whatever position the owner needs
+(chunk index, rows done, content fingerprint). Manifests written before this
+field existed load unchanged: ``progress`` is simply absent and `progress()`
+returns None.
 """
 
 from __future__ import annotations
@@ -67,10 +75,14 @@ class PipelineCheckpoint:
         fingerprint: str,
         outputs: Sequence[str] = (),
         extra: Mapping[str, Any] | None = None,
+        progress: Mapping[str, Any] | None = None,
     ) -> dict:
         """Pin each output's current content (also writing its
         ``<key>.ptr.json`` so `ResilientStore` verifies later reads) and
-        persist the stage manifest."""
+        persist the stage manifest. ``progress`` (optional) marks a
+        partially complete stage; when None the key is omitted entirely so
+        whole-stage manifests stay byte-identical to format-1 files written
+        before the field existed."""
         pointers = {key: self.store.write_pointer(key) for key in outputs}
         manifest = {
             "format": MANIFEST_FORMAT,
@@ -80,8 +92,65 @@ class PipelineCheckpoint:
             "pointers": pointers,
             "extra": dict(extra or {}),
         }
+        if progress is not None:
+            manifest["progress"] = dict(progress)
         self.store.put_json(self.manifest_key(stage), manifest)
         return manifest
+
+    def advance(
+        self,
+        stage: str,
+        *,
+        fingerprint: str,
+        new_outputs: Sequence[str] = (),
+        progress: Mapping[str, Any] | None = None,
+        extra: Mapping[str, Any] | None = None,
+    ) -> dict:
+        """Append partial progress to a stage without re-pinning history.
+
+        Loads the existing manifest (when its fingerprint still matches —
+        a config change discards stale progress and starts over), pins only
+        ``new_outputs``, and replaces the ``progress`` payload. A streaming
+        stage calling this after every chunk pays O(chunk) per call instead
+        of `write`'s O(all outputs so far) re-hash."""
+        manifest = self.load(stage)
+        if manifest is None or manifest.get("fingerprint") != fingerprint:
+            manifest = {
+                "format": MANIFEST_FORMAT,
+                "stage": stage,
+                "fingerprint": fingerprint,
+                "outputs": [],
+                "pointers": {},
+                "extra": dict(extra or {}),
+            }
+        elif extra is not None:
+            manifest["extra"] = dict(extra)
+        for key in new_outputs:
+            manifest["pointers"][key] = self.store.write_pointer(key)
+            if key not in manifest["outputs"]:
+                manifest["outputs"].append(key)
+        if progress is not None:
+            manifest["progress"] = dict(progress)
+        self.store.put_json(self.manifest_key(stage), manifest)
+        return manifest
+
+    def progress(
+        self, stage: str, fingerprint: str | None = None
+    ) -> dict | None:
+        """The stage's partial-progress payload, or None when the stage has
+        none (including every pre-progress manifest). With ``fingerprint``,
+        progress recorded under a different config reads as None — resuming
+        code treats it exactly like a fresh start."""
+        manifest = self.load(stage)
+        if manifest is None:
+            return None
+        if (
+            fingerprint is not None
+            and manifest.get("fingerprint") != fingerprint
+        ):
+            return None
+        progress = manifest.get("progress")
+        return dict(progress) if isinstance(progress, dict) else None
 
     def load(self, stage: str) -> dict | None:
         """The stage's manifest, or None when missing/unreadable/foreign."""

@@ -1,0 +1,65 @@
+"""Portfolio & stress scenarios — offline batch scoring over the mesh
+(README "Portfolio & stress scenarios").
+
+The serving stack answers "score this applicant now"; this package answers
+the risk-review question "what happens to the *whole book* under stress":
+
+- `grid` — the `ScenarioGrid` counterfactual DSL (rate shocks, income/DTI
+  multipliers, arbitrary per-feature deltas, cross-product stress grids)
+  with deterministic expansion ordering;
+- `engine` — `PortfolioScorer`, chunked mesh-sharded scoring with
+  chunk-level checkpoint/resume (kill after K chunks, resume, bit-identical
+  scores) on the same compiled programs live serving dispatches;
+- `report` — pure reducers (PD deltas, band-migration matrices, SHAP
+  movers, PSI OOD flags) and the JSON report writer.
+
+Surfaced as ``tools/score_portfolio.py``.
+"""
+
+from cobalt_smart_lender_ai_tpu.scenario.engine import (
+    PortfolioInterrupted,
+    PortfolioScorer,
+    load_portfolio,
+)
+from cobalt_smart_lender_ai_tpu.scenario.grid import (
+    BASELINE,
+    Perturbation,
+    Scenario,
+    ScenarioAxis,
+    ScenarioGrid,
+    feature_delta,
+    feature_multiplier,
+    feature_set,
+)
+from cobalt_smart_lender_ai_tpu.scenario.report import (
+    DEFAULT_PD_BANDS,
+    band_labels,
+    band_migration,
+    delta_stats,
+    pd_band_index,
+    scenario_drift,
+    shap_top_movers,
+    write_report,
+)
+
+__all__ = [
+    "BASELINE",
+    "DEFAULT_PD_BANDS",
+    "Perturbation",
+    "PortfolioInterrupted",
+    "PortfolioScorer",
+    "Scenario",
+    "ScenarioAxis",
+    "ScenarioGrid",
+    "band_labels",
+    "band_migration",
+    "delta_stats",
+    "feature_delta",
+    "feature_multiplier",
+    "feature_set",
+    "load_portfolio",
+    "pd_band_index",
+    "scenario_drift",
+    "shap_top_movers",
+    "write_report",
+]

@@ -34,6 +34,7 @@ SCHEMA_VERSION = 1
 _DISPATCH_SECONDS_FAMILIES: tuple[str, ...] = (
     "cobalt_search_dispatch_seconds",
     "cobalt_bulk_dispatch_seconds",
+    "cobalt_portfolio_dispatch_seconds",
 )
 
 

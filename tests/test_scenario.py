@@ -1,0 +1,410 @@
+"""Portfolio scenario engine: grid determinism, hand-computed delta math,
+kill/resume bit-parity on the forced mesh, checkpoint progress back-compat,
+batch deadline semantics, PSI OOD flagging, and report/ledger round-trip.
+
+The parity tests extend `tests/test_partitioner.py`'s contract one layer
+up: not only is a mesh dispatch bit-identical to a single-device one, but a
+chunked, checkpointed, killed-and-resumed *sweep* concatenates to the same
+bits as an uninterrupted run — `np.array_equal`, no tolerances.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+from cobalt_smart_lender_ai_tpu.reliability.checkpoint import (
+    PipelineCheckpoint,
+    config_fingerprint,
+)
+from cobalt_smart_lender_ai_tpu.reliability.deadline import Deadline
+from cobalt_smart_lender_ai_tpu.reliability.errors import DeadlineExceeded
+from cobalt_smart_lender_ai_tpu.scenario import (
+    BASELINE,
+    PortfolioInterrupted,
+    PortfolioScorer,
+    Scenario,
+    ScenarioGrid,
+    band_migration,
+    delta_stats,
+    feature_delta,
+    feature_multiplier,
+    pd_band_index,
+    scenario_drift,
+)
+from cobalt_smart_lender_ai_tpu.telemetry.drift import FeatureSketch
+
+SHARDS = 4
+CHUNK = 64
+
+
+@pytest.fixture(scope="module")
+def portfolio_setup(serving_artifact):
+    """(store, artifact, 256-row float32 portfolio matrix)."""
+    store, X = serving_artifact
+    art = GBDTArtifact.load(store, "models/gbdt/model_tree")
+    return store, art, np.ascontiguousarray(X[:256], dtype=np.float32)
+
+
+def _grid():
+    return ScenarioGrid(
+        [
+            feature_delta("installment", [25.0, 50.0]),
+            feature_multiplier("loan_amnt", [0.9]),
+        ]
+    )
+
+
+# --- grid DSL ----------------------------------------------------------------
+
+
+def test_grid_expansion_deterministic_order():
+    grid = ScenarioGrid(
+        [
+            feature_delta("installment", [10.0, 20.0]),
+            feature_multiplier("loan_amnt", [0.8, 1.2]),
+        ]
+    )
+    ids = [s.scenario_id for s in grid.expand()]
+    # Axes in declaration order, rightmost axis fastest (itertools.product).
+    assert ids == [
+        "installment+10,loan_amntx0.8",
+        "installment+10,loan_amntx1.2",
+        "installment+20,loan_amntx0.8",
+        "installment+20,loan_amntx1.2",
+    ]
+    assert len(grid) == 4
+    # Expansion is a pure function of the grid: repeat calls agree exactly.
+    assert [s.scenario_id for s in grid.expand()] == ids
+
+
+def test_grid_json_roundtrip_preserves_order():
+    grid = _grid()
+    clone = ScenarioGrid.from_json(json.loads(json.dumps(grid.to_json())))
+    assert [s.scenario_id for s in clone.expand()] == [
+        s.scenario_id for s in grid.expand()
+    ]
+    assert clone.to_json() == grid.to_json()
+
+
+def test_scenario_apply_ops_and_unknown_feature():
+    names = ["a", "b"]
+    X = np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    s = ScenarioGrid(
+        [feature_delta("a", [10.0]), feature_multiplier("b", [0.5])]
+    ).expand()[0]
+    out = s.apply(X, names)
+    np.testing.assert_array_equal(
+        out, np.asarray([[11.0, 1.0], [13.0, 2.0]], np.float32)
+    )
+    np.testing.assert_array_equal(  # input untouched
+        X, np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    )
+    assert BASELINE.apply(X, names).tolist() == X.tolist()
+    with pytest.raises(KeyError, match="unknown"):
+        Scenario("bad", s.perturbations).apply(X, ["a", "c"])
+
+
+# --- delta math on a hand-computed grid --------------------------------------
+
+
+def test_band_migration_and_delta_stats_hand_computed():
+    bands = (0.02, 0.08, 0.20, 0.50)
+    baseline = np.asarray([0.01, 0.04, 0.10, 0.30])
+    scenario = np.asarray([0.03, 0.09, 0.12, 0.60])
+    assert pd_band_index(baseline, bands).tolist() == [0, 1, 2, 3]
+    assert pd_band_index(scenario, bands).tolist() == [1, 2, 2, 4]
+    mig = band_migration(baseline, scenario, bands)
+    assert mig["downgraded"] == 3
+    assert mig["upgraded"] == 0
+    assert mig["unchanged"] == 1
+    matrix = np.asarray(mig["matrix"])
+    assert matrix.sum() == 4
+    assert matrix[0][1] == matrix[1][2] == matrix[2][2] == matrix[3][4] == 1
+    stats = delta_stats(baseline, scenario)
+    assert stats["mean"] == pytest.approx((0.02 + 0.05 + 0.02 + 0.30) / 4)
+    assert stats["max"] == pytest.approx(0.30)
+    assert stats["min"] == pytest.approx(0.02)
+
+
+def test_engine_delta_math_consistent_on_2x2_grid(portfolio_setup):
+    """Every report delta must re-derive exactly from the landed score
+    arrays — the reducers and the artifacts cannot disagree."""
+    store, art, X = portfolio_setup
+    grid = ScenarioGrid(
+        [
+            feature_delta("installment", [10.0, 20.0]),
+            feature_multiplier("loan_amnt", [0.8, 1.2]),
+        ]
+    )
+    scorer = PortfolioScorer(
+        art, store, shards=1, chunk_rows=CHUNK, compute_shap=False
+    )
+    report = scorer.run(X[:128], grid, run_id="t-2x2")
+    assert len(report["scenarios"]) == 4
+    base = store.load_array(report["keys"]["scores"]["baseline"])
+    for block in report["scenarios"]:
+        scores = store.load_array(block["scores_key"])
+        deltas = store.load_array(block["deltas_key"])
+        np.testing.assert_array_equal(
+            deltas, np.asarray(scores, np.float64) - np.asarray(base, np.float64)
+        )
+        assert block["delta"]["mean"] == pytest.approx(float(deltas.mean()))
+        assert block["mean_pd"] == pytest.approx(float(scores.mean()))
+        mig = block["migration"]
+        assert mig["downgraded"] + mig["upgraded"] + mig["unchanged"] == 128
+        assert int(np.asarray(mig["matrix"]).sum()) == 128
+
+
+# --- kill / resume bit-parity on the forced mesh -----------------------------
+
+
+def test_resume_mid_sweep_bit_parity(portfolio_setup):
+    store, art, X = portfolio_setup
+    grid = _grid()
+
+    ref = PortfolioScorer(art, store, shards=SHARDS, chunk_rows=CHUNK).run(
+        X, grid, run_id="t-ref"
+    )
+    assert ref["partitioner"]["shards"] == SHARDS
+    assert ref["resume"]["chunks_resumed"] == 0
+
+    killed = PortfolioScorer(art, store, shards=SHARDS, chunk_rows=CHUNK)
+    with pytest.raises(PortfolioInterrupted):
+        killed.run(X, grid, run_id="t-kill", fail_after_chunks=5)
+    resumed = killed.run(X, grid, run_id="t-kill", resume=True)
+    assert resumed["resume"]["chunks_resumed"] == 5
+    assert (
+        resumed["resume"]["chunks_scored"]
+        == resumed["resume"]["chunks_total"] - 5
+    )
+
+    for sid, key in ref["keys"]["scores"].items():
+        a = store.load_array(key)
+        b = store.load_array(resumed["keys"]["scores"][sid])
+        assert np.array_equal(a, b), f"scenario {sid} drifted across resume"
+
+    # Mesh-vs-single through the whole engine: same contract one layer up
+    # from tests/test_partitioner.py.
+    single = PortfolioScorer(art, store, shards=1, chunk_rows=CHUNK).run(
+        X, grid, run_id="t-single"
+    )
+    for sid, key in ref["keys"]["scores"].items():
+        assert np.array_equal(
+            store.load_array(key),
+            store.load_array(single["keys"]["scores"][sid]),
+        ), f"scenario {sid} differs mesh vs single"
+
+    # Resume without a matching checkpoint (fresh run-id) scores everything.
+    fresh = PortfolioScorer(art, store, shards=SHARDS, chunk_rows=CHUNK).run(
+        X, grid, run_id="t-fresh", resume=True
+    )
+    assert fresh["resume"]["chunks_resumed"] == 0
+
+
+# --- checkpoint progress payload + back-compat -------------------------------
+
+
+def test_checkpoint_progress_backcompat(tmp_path):
+    store = ObjectStore(str(tmp_path / "lake"))
+    store.put_bytes("out/a.bin", b"alpha")
+    ckpt = PipelineCheckpoint(store)
+    fp = config_fingerprint({"v": 1})
+
+    # Old-style whole-stage write: no progress key in the JSON at all.
+    ckpt.write("legacy", fingerprint=fp, outputs=["out/a.bin"])
+    raw = store.get_json(ckpt.manifest_key("legacy"))
+    assert "progress" not in raw
+    assert ckpt.valid("legacy", fp)
+    assert ckpt.progress("legacy") is None
+
+    # A pre-progress manifest written by an older build loads unchanged.
+    import hashlib
+
+    old = {
+        "format": 1,
+        "stage": "ancient",
+        "fingerprint": fp,
+        "outputs": ["out/a.bin"],
+        "pointers": {
+            "out/a.bin": {
+                "key": "out/a.bin",
+                "md5": hashlib.md5(b"alpha").hexdigest(),
+                "size": 5,
+            }
+        },
+        "extra": {},
+    }
+    store.put_json(ckpt.manifest_key("ancient"), old)
+    assert ckpt.load("ancient") == old
+    assert ckpt.valid("ancient", fp)
+    assert ckpt.progress("ancient") is None
+
+    # Progress payloads round-trip and advance() accumulates outputs
+    # without dropping history.
+    store.put_bytes("out/b.bin", b"beta")
+    ckpt.advance(
+        "stream",
+        fingerprint=fp,
+        new_outputs=["out/a.bin"],
+        progress={"items_done": 1, "items_total": 2},
+    )
+    ckpt.advance(
+        "stream",
+        fingerprint=fp,
+        new_outputs=["out/b.bin"],
+        progress={"items_done": 2, "items_total": 2},
+    )
+    manifest = ckpt.load("stream")
+    assert manifest["outputs"] == ["out/a.bin", "out/b.bin"]
+    assert ckpt.progress("stream") == {"items_done": 2, "items_total": 2}
+    assert ckpt.valid("stream", fp)
+
+    # A fingerprint change discards stale progress (fresh start semantics).
+    fp2 = config_fingerprint({"v": 2})
+    assert ckpt.progress("stream", fp2) is None
+    ckpt.advance("stream", fingerprint=fp2, progress={"items_done": 0})
+    assert ckpt.load("stream")["outputs"] == []
+
+
+# --- batch deadline semantics ------------------------------------------------
+
+
+class _TickClock:
+    """Each read advances 30 fake seconds — a multi-minute-shaped run."""
+
+    def __init__(self, step: float = 30.0):
+        self.now = 0.0
+        self.step = step
+
+    def __call__(self) -> float:
+        self.now += self.step
+        return self.now
+
+
+def test_deadline_none_never_aborts_long_runs(portfolio_setup):
+    store, art, X = portfolio_setup
+    clock = _TickClock(30.0)
+    scorer = PortfolioScorer(
+        art, store, shards=1, chunk_rows=CHUNK, compute_shap=False,
+        clock=clock,
+    )
+    # 4 chunks x 30s+ of fake clock per chunk: far beyond any serving
+    # deadline. deadline=None (the default) must never 504 the sweep.
+    report = scorer.run(X, None, run_id="t-slow")
+    assert clock.now > 120.0, "fake clock should have spanned minutes"
+    assert report["resume"]["chunks_scored"] == 4
+    assert report["baseline"]["mean_pd"] > 0.0
+
+
+def test_explicit_deadline_still_honored_between_chunks(portfolio_setup):
+    store, art, X = portfolio_setup
+    clock = _TickClock(30.0)
+    scorer = PortfolioScorer(
+        art, store, shards=1, chunk_rows=CHUNK, compute_shap=False,
+        clock=clock,
+    )
+    with pytest.raises(DeadlineExceeded):
+        scorer.run(
+            X, None, run_id="t-budget",
+            deadline=Deadline(45.0, clock=clock),
+        )
+    # The tripped budget left a resumable checkpoint, not a corrupt run.
+    resumed = scorer.run(X, None, run_id="t-budget", resume=True)
+    ref = store.load_array(
+        PortfolioScorer(
+            art, store, shards=1, chunk_rows=CHUNK, compute_shap=False
+        ).run(X, None, run_id="t-budget-ref")["keys"]["scores"]["baseline"]
+    )
+    assert np.array_equal(
+        store.load_array(resumed["keys"]["scores"]["baseline"]), ref
+    )
+
+
+# --- PSI OOD flagging --------------------------------------------------------
+
+
+def test_scenario_drift_flags_ood_stress_points():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(800, 2)).astype(np.float32)
+    names = ["installment", "loan_amnt"]
+    sketch = FeatureSketch.from_data(X, names, bins=10)
+
+    benign = scenario_drift(sketch, X.copy(), names, ["installment"])
+    assert benign["ood_features"] == []
+    assert not benign["ood"]
+    assert benign["psi"]["installment"] < 0.05
+
+    shifted = X.copy()
+    shifted[:, 0] += 50.0
+    ood = scenario_drift(sketch, shifted, names, ["installment"])
+    assert ood["ood_features"] == ["installment"]
+    assert ood["ood"]
+    assert ood["psi"]["installment"] > 0.25
+    # Only perturbed features are scored — the warning targets the grid.
+    assert "loan_amnt" not in ood["psi"]
+
+
+def test_engine_reports_ood_warning_not_failure(portfolio_setup):
+    store, art, X = portfolio_setup
+    sketch = FeatureSketch.from_data(
+        X, list(art.feature_names), bins=10
+    )
+    grid = ScenarioGrid([feature_delta("installment", [0.0, 1e6])])
+    report = PortfolioScorer(
+        art, store, shards=1, chunk_rows=CHUNK, compute_shap=False,
+        training_sketch=sketch,
+    ).run(X[:128], grid, run_id="t-ood")
+    benign, extreme = report["scenarios"]
+    assert not benign["drift"]["ood"]
+    assert extreme["drift"]["ood_features"] == ["installment"]
+    assert extreme["drift"]["psi"]["installment"] > 0.25
+
+    # Without a sketch the report says why PSI was skipped.
+    no_sketch = PortfolioScorer(
+        art, store, shards=1, chunk_rows=CHUNK, compute_shap=False
+    ).run(X[:128], None, run_id="t-nosketch")
+    assert "drift_note" in no_sketch
+
+
+# --- report / ledger round-trip ----------------------------------------------
+
+
+def test_report_and_ledger_roundtrip(portfolio_setup, tmp_path):
+    from cobalt_smart_lender_ai_tpu.telemetry import RunLedger, load_ledger
+    from tools.obs_report import render_report
+
+    store, art, X = portfolio_setup
+    grid = ScenarioGrid([feature_delta("installment", [25.0])])
+    ledger = RunLedger("portfolio", meta={"run_id": "t-ledger"})
+    scorer = PortfolioScorer(art, store, shards=SHARDS, chunk_rows=CHUNK)
+    report = scorer.run(X[:128], grid, run_id="t-ledger", ledger=ledger)
+
+    # The report in the store is the report the engine returned (minus the
+    # in-memory-only stage timings appended after the write).
+    stored = store.get_json(report["keys"]["report"])
+    assert stored["run_id"] == "t-ledger"
+    assert stored["fingerprint"] == report["fingerprint"]
+    assert stored["resume"] == report["resume"]
+    assert [b["id"] for b in stored["scenarios"]] == ["installment+25"]
+    assert stored["partitioner"]["shards"] == SHARDS
+    assert store.exists(stored["keys"]["scores"]["baseline"])
+
+    doc = ledger.write(str(tmp_path / "ledger.json"))
+    loaded = load_ledger(str(tmp_path / "ledger.json"))
+    assert loaded["kind"] == "portfolio"
+    assert set(doc["stages"]) >= {"compile", "score", "reduce", "write"}
+    assert loaded["scenario_report"]["run_id"] == "t-ledger"
+    # The portfolio dispatch family is a measured family: attribution has
+    # a denominator and the portfolio.* programs cover it.
+    assert "cobalt_portfolio_dispatch_seconds" in loaded["metrics"]
+    attr = loaded["dispatch_attribution"]
+    assert attr["ratio"] is not None
+    assert attr["ratio"] >= 0.8
+    assert any(
+        p["name"].startswith("portfolio.") for p in loaded["programs"]
+    )
+    rendered = render_report(loaded)
+    assert "portfolio." in rendered
+    assert "Dispatch attribution" in rendered

@@ -1,0 +1,241 @@
+"""Portfolio & stress-scenario driver — the offline batch workload.
+
+Streams a portfolio CSV from the object store through the mesh-sharded bulk
+margin+SHAP programs in checkpointed chunks, sweeps a counterfactual
+`ScenarioGrid`, and lands scores, per-scenario deltas, and a JSON scenario
+report back in the store under ``scenario_runs/<run-id>/``. A killed run
+(preemption, OOM, or the deterministic ``--fail-after-chunks`` test hook)
+resumes with ``--resume`` and produces scores bit-identical to an
+uninterrupted run.
+
+Usage:
+    python tools/score_portfolio.py --store artifacts \
+        --portfolio portfolios/book.csv --scenarios scenarios.json \
+        --shards -1 --run-id 2026q3-stress [--resume] \
+        [--ledger-out ledger.json] [--trace-out trace.json]
+
+The model comes from the registry (``--model-name``/``--channel``, default
+the ``latest`` champion) so the report carries version provenance and the
+training feature sketch for PSI OOD flagging; ``--model-key`` bypasses the
+registry for ad-hoc artifacts. ``--scenarios`` is a JSON file of grid axes::
+
+    {"axes": [{"feature": "installment", "op": "add", "values": [25, 50]},
+              {"feature": "annual_inc", "op": "mul", "values": [0.9, 1.0]}]}
+
+``--synthetic-portfolio N`` writes an N-row synthetic portfolio at
+``--portfolio`` when the key is absent (CI / demo bootstrap). Exit codes:
+0 success, 3 interrupted-but-resumable (the ``--fail-after-chunks`` path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _build_synthetic_portfolio(store, key: str, rows: int, seed: int) -> None:
+    """An N-row serving-feature portfolio CSV from the synthetic generator
+    (same clean -> engineer -> select path the retrain driver trains on)."""
+    import pandas as pd
+
+    from cobalt_smart_lender_ai_tpu.data import (
+        clean_raw_frame,
+        engineer_features,
+        prepare_cleaned_frame,
+        synthetic_lendingclub_frame,
+    )
+    from cobalt_smart_lender_ai_tpu.data import schema
+
+    raw = synthetic_lendingclub_frame(n_rows=rows, seed=seed)
+    cleaned, _ = clean_raw_frame(raw)
+    tree_ff, _, _ = engineer_features(prepare_cleaned_frame(cleaned))
+    ff = tree_ff.select(schema.SERVING_FEATURES)
+    import numpy as np
+
+    frame = pd.DataFrame(
+        np.asarray(ff.X, dtype=np.float32), columns=list(ff.feature_names)
+    )
+    store.save_frame(key, frame)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--store", default="artifacts")
+    ap.add_argument("--portfolio", default="portfolios/portfolio.csv",
+                    help="store key of the portfolio CSV to score")
+    ap.add_argument("--scenarios", default=None,
+                    help="path to a ScenarioGrid JSON file (omit for a "
+                    "baseline-only run)")
+    ap.add_argument("--run-id", default=None,
+                    help="run-versioned output namespace (default: "
+                    "portfolio-<unixtime>)")
+    ap.add_argument("--resume", action="store_true",
+                    help="continue a killed run with the same --run-id")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="bulk mesh shards: 0/1 single device, -1 all "
+                    "visible devices, N an N-way dp mesh")
+    ap.add_argument("--chunk-rows", type=int, default=2048)
+    ap.add_argument("--no-shap", action="store_true",
+                    help="skip SHAP attribution (margin-only sweep)")
+    ap.add_argument("--model-name", default="gbdt")
+    ap.add_argument("--channel", default="latest")
+    ap.add_argument("--registry-prefix", default="registry")
+    ap.add_argument("--model-key", default=None,
+                    help="bypass the registry: load this artifact key "
+                    "directly (no provenance / PSI baseline)")
+    ap.add_argument("--deadline-s", type=float, default=None,
+                    help="optional wall-clock budget; default None = batch "
+                    "runs never abort themselves")
+    ap.add_argument("--synthetic-portfolio", type=int, default=None,
+                    metavar="ROWS",
+                    help="generate an N-row synthetic portfolio at "
+                    "--portfolio when the key does not exist")
+    ap.add_argument("--seed", type=int, default=29)
+    ap.add_argument("--fail-after-chunks", type=int, default=None,
+                    help="deterministic kill hook: raise after K freshly "
+                    "scored chunks (exit 3, checkpoint resumable) — "
+                    "CI/test use")
+    ap.add_argument("--ledger-out", default=None,
+                    help="write a run ledger here; render with "
+                    "tools/obs_report.py")
+    ap.add_argument("--trace-out", default=None,
+                    help="write the run's spans as Perfetto JSON here")
+    args = ap.parse_args(argv)
+
+    from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
+    from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+    from cobalt_smart_lender_ai_tpu.reliability.deadline import start_deadline
+    from cobalt_smart_lender_ai_tpu.scenario import (
+        PortfolioInterrupted,
+        PortfolioScorer,
+        ScenarioGrid,
+        load_portfolio,
+    )
+
+    bootstrap_compile_cache()
+    store = ObjectStore(args.store)
+    run_id = args.run_id or f"portfolio-{int(time.time())}"
+
+    if args.synthetic_portfolio and not store.exists(args.portfolio):
+        _build_synthetic_portfolio(
+            store, args.portfolio, args.synthetic_portfolio, args.seed
+        )
+
+    grid = None
+    if args.scenarios:
+        with open(args.scenarios) as fh:
+            grid = ScenarioGrid.from_json(json.load(fh))
+
+    if args.model_key:
+        scorer = PortfolioScorer(
+            GBDTArtifact.load(store, args.model_key),
+            store,
+            shards=args.shards,
+            chunk_rows=args.chunk_rows,
+            compute_shap=not args.no_shap,
+            model_info={"key": args.model_key, "channel": "direct"},
+        )
+    else:
+        scorer = PortfolioScorer.from_registry(
+            store,
+            model_name=args.model_name,
+            channel=args.channel,
+            registry_prefix=args.registry_prefix,
+            shards=args.shards,
+            chunk_rows=args.chunk_rows,
+            compute_shap=not args.no_shap,
+        )
+
+    ledger = None
+    if args.ledger_out:
+        from cobalt_smart_lender_ai_tpu.telemetry import (
+            RunLedger,
+            install_device_metrics,
+            install_program_metrics,
+        )
+
+        install_program_metrics()
+        install_device_metrics()
+        ledger = RunLedger(
+            "portfolio",
+            meta={
+                "run_id": run_id,
+                "portfolio": args.portfolio,
+                "shards": args.shards,
+                "chunk_rows": args.chunk_rows,
+                "resume": bool(args.resume),
+            },
+        )
+
+    X, portfolio_meta = load_portfolio(
+        store, args.portfolio, scorer.artifact.feature_names
+    )
+
+    def _finish_artifacts():
+        if ledger is not None:
+            ledger.write(args.ledger_out)
+        if args.trace_out:
+            from cobalt_smart_lender_ai_tpu.telemetry import (
+                default_tracer,
+                render_chrome_trace,
+            )
+
+            with open(args.trace_out, "w") as fh:
+                fh.write(render_chrome_trace(default_tracer()))
+
+    try:
+        report = scorer.run(
+            X,
+            grid,
+            run_id=run_id,
+            resume=args.resume,
+            deadline=start_deadline(args.deadline_s),
+            fail_after_chunks=args.fail_after_chunks,
+            ledger=ledger,
+            portfolio_meta=portfolio_meta,
+        )
+    except PortfolioInterrupted as exc:
+        if ledger is not None:
+            ledger.set(
+                "scenario_report",
+                {"run_id": run_id, "interrupted": True,
+                 "items_done": exc.items_done,
+                 "items_total": exc.items_total},
+            )
+        _finish_artifacts()
+        print(json.dumps({
+            "run_id": run_id,
+            "interrupted": True,
+            "items_done": exc.items_done,
+            "items_total": exc.items_total,
+            "resume_with": "--resume",
+        }))
+        return 3
+
+    if ledger is not None:
+        ledger.fingerprint = report["fingerprint"]
+    _finish_artifacts()
+    print(json.dumps({
+        "run_id": run_id,
+        "report_key": report["keys"]["report"],
+        "rows": report["portfolio"]["rows"],
+        "scenarios": len(report["scenarios"]),
+        "chunks_resumed": report["resume"]["chunks_resumed"],
+        "chunks_scored": report["resume"]["chunks_scored"],
+        "rows_per_second": report["telemetry"]["rows_per_second"],
+        "shards": report["partitioner"]["shards"],
+        "ood_scenarios": [
+            b["id"] for b in report["scenarios"]
+            if (b.get("drift") or {}).get("ood")
+        ],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
