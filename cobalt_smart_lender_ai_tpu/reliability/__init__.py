@@ -53,6 +53,7 @@ from cobalt_smart_lender_ai_tpu.reliability.checkpoint import (
 )
 from cobalt_smart_lender_ai_tpu.reliability.deadline import (
     Deadline,
+    await_under_deadline,
     start_deadline,
 )
 from cobalt_smart_lender_ai_tpu.reliability.errors import (
@@ -111,5 +112,6 @@ __all__ = [
     "error_response",
     "is_transient_store_error",
     "policy_from_config",
+    "await_under_deadline",
     "start_deadline",
 ]

@@ -13,10 +13,13 @@ asserted against fake clocks in tier-1 — no test ever sleeps for real.
 
 from __future__ import annotations
 
+import asyncio
 import time
-from typing import Callable
+from typing import Awaitable, Callable, TypeVar
 
 from cobalt_smart_lender_ai_tpu.reliability.errors import DeadlineExceeded
+
+_T = TypeVar("_T")
 
 
 class Deadline:
@@ -56,6 +59,38 @@ class Deadline:
         bodies say what was abandoned, not just that something was."""
         if self.remaining() <= 0.0:
             raise self.exceeded(checkpoint)
+
+
+async def await_under_deadline(
+    awaitable: Awaitable[_T],
+    deadline: Deadline | None,
+    checkpoint: str = "request",
+) -> _T:
+    """Await ``awaitable`` under a loop-scheduled timeout.
+
+    The async twin of `Deadline.check`: instead of a thread parked on
+    ``Future.result()`` discovering the expiry only when the worker resolves
+    it, the event loop itself schedules the 504 — ``deadline.remaining()``
+    becomes an ``asyncio.wait_for`` timer, so a queued request whose budget
+    runs out resolves `DeadlineExceeded` without consuming a batch slot or
+    waking any worker.
+
+    The awaitable is shielded: on timeout it is *abandoned*, not cancelled —
+    the micro-batch worker still owns the underlying future and resolves it
+    later (the queued entry is skipped as expired at the next collection,
+    which is also where the ``expired{where="queued"}`` counter increments
+    exactly once). `MicroBatcher.submit_async` attaches the done-callback
+    that retrieves the abandoned future's eventual exception.
+    """
+    if deadline is None:
+        return await awaitable
+    fut = asyncio.ensure_future(awaitable)
+    try:
+        return await asyncio.wait_for(
+            asyncio.shield(fut), timeout=max(0.0, deadline.remaining())
+        )
+    except (asyncio.TimeoutError, TimeoutError):
+        raise deadline.exceeded(checkpoint) from None
 
 
 def start_deadline(

@@ -13,10 +13,17 @@
   replicas (one per device, or thread-backed on CPU) behind a least-loaded
   router presenting the same service surface, with ``cobalt_replica_*``
   metrics and atomic all-replica hot reload (README "Scaling out").
-- `http_stdlib` — zero-dependency http.server adapter (this image has no
-  fastapi); serves the same routes/status codes plus ``POST /admin/reload``.
+- `http_asyncio` — the default zero-dependency frontend: one asyncio event
+  loop from socket accept to batcher future. Request coroutines suspend on
+  ``MicroBatcher.submit_async`` / deadline awaits instead of parking OS
+  threads, so hundreds of in-flight requests cost one thread total.
+- `http_stdlib` — the legacy thread-per-connection http.server adapter.
+  Deprecated; kept for one release as the rollback path
+  (``--serve-impl threaded``) with a byte-parity test against the asyncio
+  adapter.
 - `http_fastapi` — FastAPI adapter with the exact pydantic `SingleInput`
-  contract, for deployments that have fastapi installed.
+  contract, for deployments that have fastapi installed; scoring endpoints
+  are native ``async def`` (no threadpool offload).
 
 Both adapters map failures through the one error taxonomy in
 `reliability.errors` (422 invalid_input / 413 payload_too_large / 429 shed /
@@ -32,6 +39,10 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
     RequestError,
     RequestShed,
 )
+from cobalt_smart_lender_ai_tpu.serve.http_asyncio import (
+    AsyncScorerServer,
+    make_async_server,
+)
 from cobalt_smart_lender_ai_tpu.serve.replicas import (
     ReplicaSet,
     resolve_replica_devices,
@@ -46,6 +57,7 @@ from cobalt_smart_lender_ai_tpu.serve.service import (
 
 __all__ = [
     "SINGLE_INPUT_FIELDS",
+    "AsyncScorerServer",
     "CircuitOpenError",
     "DeadlineExceeded",
     "MicroBatcher",
@@ -55,6 +67,7 @@ __all__ = [
     "RequestShed",
     "ScorerService",
     "ValidationError",
+    "make_async_server",
     "resolve_replica_devices",
     "validate_single_input",
 ]

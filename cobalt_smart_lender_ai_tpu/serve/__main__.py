@@ -1,8 +1,18 @@
 """CLI entry: restore the model artifact and serve the reference's HTTP
 contract — ``python -m cobalt_smart_lender_ai_tpu.serve --store artifacts``.
 
-Prefers the FastAPI adapter when fastapi+uvicorn are installed, otherwise
-falls back to the stdlib server; both expose identical routes.
+``--serve-impl`` picks the frontend (all expose identical routes):
+
+- ``auto`` (default): FastAPI when fastapi+uvicorn are installed, else the
+  asyncio stdlib-only server.
+- ``asyncio``: the event-loop server (`serve.http_asyncio`) — one loop from
+  socket accept to batcher future; request coroutines suspend on awaits
+  instead of parking OS threads.
+- ``threaded``: the legacy thread-per-connection adapter
+  (`serve.http_stdlib`). Deprecated — kept for one release as the rollback
+  path while the asyncio core beds in; a parity test pins both adapters to
+  byte-identical bodies.
+- ``fastapi``: force the FastAPI adapter (errors if fastapi is missing).
 """
 
 from __future__ import annotations
@@ -106,6 +116,14 @@ def main() -> None:
         help="fraction of scoring traffic shadow-scored against the canary",
     )
     parser.add_argument(
+        "--serve-impl",
+        choices=("auto", "asyncio", "threaded", "fastapi"),
+        default="auto",
+        help="HTTP frontend: auto (fastapi if installed, else asyncio), "
+        "asyncio (event-loop server), threaded (deprecated rollback "
+        "adapter, removed next release), fastapi (require fastapi)",
+    )
+    parser.add_argument(
         "--profile-dir",
         default=None,
         help="capture a jax.profiler trace of the whole serving session "
@@ -170,19 +188,40 @@ def main() -> None:
     if args.profile_dir:
         print(f"[INFO] profiler trace capturing to {args.profile_dir}")
     with profile_trace(args.profile_dir):
-        try:
-            import uvicorn  # noqa: F401
+        impl = args.serve_impl
+        if impl in ("auto", "fastapi"):
+            try:
+                import uvicorn  # noqa: F401
 
-            from cobalt_smart_lender_ai_tpu.serve.http_fastapi import create_app
+                from cobalt_smart_lender_ai_tpu.serve.http_fastapi import (
+                    create_app,
+                )
 
-            app = create_app(service=service)
-            print(f"[INFO] serving (fastapi) on {cfg.host}:{cfg.port}")
-            uvicorn.run(app, host=cfg.host, port=cfg.port)
-        except ImportError:
-            from cobalt_smart_lender_ai_tpu.serve.http_stdlib import serve_forever
+                app = create_app(service=service)
+                print(f"[INFO] serving (fastapi) on {cfg.host}:{cfg.port}")
+                uvicorn.run(app, host=cfg.host, port=cfg.port)
+                return
+            except ImportError:
+                if impl == "fastapi":
+                    raise SystemExit(
+                        "--serve-impl fastapi requires fastapi+uvicorn"
+                    )
+        if impl == "threaded":
+            from cobalt_smart_lender_ai_tpu.serve.http_stdlib import (
+                serve_forever,
+            )
 
-            print(f"[INFO] serving (stdlib) on {cfg.host}:{cfg.port}")
+            print("[WARN] --serve-impl threaded is deprecated; it is the "
+                  "rollback path for this release only")
+            print(f"[INFO] serving (stdlib threaded) on {cfg.host}:{cfg.port}")
             serve_forever(service, cfg.host, cfg.port)
+            return
+        from cobalt_smart_lender_ai_tpu.serve.http_asyncio import (
+            serve_forever as serve_forever_async,
+        )
+
+        print(f"[INFO] serving (asyncio) on {cfg.host}:{cfg.port}")
+        serve_forever_async(service, cfg.host, cfg.port)
 
 
 if __name__ == "__main__":

@@ -283,7 +283,10 @@ class CanaryController:
     ) -> None:
         """Request-path hook: deterministic stride sampling, O(1), never
         raises. The actual canary dispatch happens on the worker thread so
-        the caller's latency is untouched."""
+        the caller's latency is untouched. Event-loop safe: the only lock
+        held is a plain mutex around a bounded in-memory append (no I/O,
+        no waits), so request coroutines on the asyncio frontend call this
+        directly without stalling the loop."""
         if self._closed:
             return
         if self._canary_model is None and self._live is None:

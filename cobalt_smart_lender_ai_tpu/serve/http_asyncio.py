@@ -1,0 +1,657 @@
+"""Asyncio-native HTTP adapter: one event loop from socket to batcher future.
+
+The default frontend (README "Performance" / "Serving guarantees"). The
+threaded stdlib adapter (`http_stdlib.py`) burns an OS thread — and its
+context switches, lock handoffs, and GIL contention — per in-flight request;
+at 128+ closed-loop clients that thread army IS the latency. Here one
+`asyncio.start_server` event loop owns the whole request path: accept, parse,
+validate, admission, micro-batch enqueue, and the wait for the batch result
+are all loop-scheduled — a request coroutine *suspends* on
+`MicroBatcher.submit_async`'s wrapped future instead of parking a thread, and
+the batcher's worker thread (the single consumer that must block on the
+device dispatch anyway) wakes it on resolve. BENCH_SERVE_r03.json measures
+the difference at 128/256/512 clients.
+
+Contract parity with `http_stdlib.py` is deliberate and byte-level: the same
+`_KNOWN_ROUTES` surface, the same typed error taxonomy
+(`reliability.errors`; 422/413/429/503/504 + the admin 409s), the same JSON
+encoder — a parity test asserts both adapters return byte-identical bodies
+for the same scoring request. The shared route helpers
+(`validate_debug_limit`, `validate_debug_phase`, `debug_programs_payload`,
+`_extract_csv`) are imported from the stdlib adapter, not re-implemented.
+
+Hardening composes unchanged in async form:
+
+- cooperative deadlines become loop-scheduled timeouts
+  (`reliability.deadline.await_under_deadline`): a queued request whose
+  budget expires resolves its 504 on the loop's timer, consuming no batch
+  slot and waking no worker;
+- admission / breaker / reload gates are plain-lock critical sections with
+  no I/O inside, so holding them from the loop thread cannot stall the loop
+  (`admission.admit()` brackets the full await, exactly like the threaded
+  adapter brackets the blocking call);
+- blocking admin work (hot reload = restore + compile; canary promote /
+  rollback) and the inherently-blocking bulk path (pandas parse + sharded
+  dispatch) run on the default executor — a bounded pool, not a thread per
+  request — so the data plane keeps serving during a swap;
+- `request_context` / trace spans / the flight phase accumulator are
+  contextvars, which asyncio snapshots per task: ids and span parentage
+  propagate across every ``await`` with zero adapter code, keeping the one
+  join key across logs, flight records, exemplars, and Perfetto export.
+
+Telemetry middleware is the same envelope as both other adapters: every
+request runs inside a `request_context` (client ``X-Request-ID`` honored,
+else minted at ingress, always echoed), a root ``http.request`` span whose id
+is the request's trace id, `observe_request` on the latency histogram,
+flight-recording for data-plane routes, and one structured log line per
+non-2xx.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import threading
+from http.client import responses as _REASONS
+from urllib.parse import parse_qs, urlsplit
+
+from cobalt_smart_lender_ai_tpu.reliability.errors import (
+    RequestError,
+    ValidationError,
+    error_response,
+)
+from cobalt_smart_lender_ai_tpu.serve.http_stdlib import (
+    _KNOWN_ROUTES,
+    _extract_csv,
+    debug_programs_payload,
+    validate_debug_limit,
+    validate_debug_phase,
+)
+from cobalt_smart_lender_ai_tpu.serve.service import ScorerService, _in_executor
+from cobalt_smart_lender_ai_tpu.telemetry import (
+    EXPOSITION_CONTENT_TYPE,
+    META_ROUTES,
+    OPENMETRICS_CONTENT_TYPE,
+    TRACE_CONTENT_TYPE,
+    collect_phases,
+    default_tracer,
+    get_logger,
+    render_chrome_trace,
+    request_context,
+)
+
+__all__ = ["AsyncScorerServer", "make_async_server", "serve_forever"]
+
+_LOG = get_logger("cobalt.serve.http_asyncio")
+
+#: Request-line + single-header ceiling — a malformed or hostile peer must
+#: not buffer unbounded bytes into the loop (readline() enforces it).
+_MAX_LINE_BYTES = 65536
+
+
+class _BadRequest(Exception):
+    """Protocol-level parse failure — answered 400 outside the route
+    middleware (there is no route yet) and the connection is closed."""
+
+
+class _Request:
+    __slots__ = ("method", "target", "headers", "body")
+
+    def __init__(self, method: str, target: str, headers: dict, body: bytes):
+        self.method = method
+        self.target = target
+        self.headers = headers  # lower-cased names
+        self.body = body
+
+
+class _State:
+    """Per-request response bookkeeping the middleware reads after the
+    route handler ran — the async mirror of the stdlib handler's
+    ``_status`` / ``_error_code`` / ``_request_id`` attributes."""
+
+    __slots__ = (
+        "writer",
+        "route_path",
+        "query",
+        "status",
+        "error_code",
+        "request_id",
+        "keep_alive",
+    )
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self.route_path = ""
+        self.query: dict = {}
+        self.status: int | None = None
+        self.error_code: str | None = None
+        self.request_id: str | None = None
+        self.keep_alive = True
+
+
+async def _read_request(reader: asyncio.StreamReader) -> _Request | None:
+    """Parse one HTTP/1.1 request (start line, headers, Content-Length
+    body). ``None`` means the peer closed cleanly between requests."""
+    line = await reader.readline()
+    if not line:
+        return None
+    if len(line) > _MAX_LINE_BYTES:
+        raise _BadRequest("request line too long")
+    parts = line.decode("latin-1").split()
+    if len(parts) != 3:
+        raise _BadRequest("malformed request line")
+    method, target, _version = parts
+    headers: dict[str, str] = {}
+    while True:
+        h = await reader.readline()
+        if h in (b"\r\n", b"\n"):
+            break
+        if not h:
+            raise _BadRequest("connection closed inside headers")
+        if len(h) > _MAX_LINE_BYTES:
+            raise _BadRequest("header line too long")
+        name, sep, value = h.decode("latin-1").partition(":")
+        if not sep:
+            raise _BadRequest("malformed header line")
+        headers[name.strip().lower()] = value.strip()
+    try:
+        length = int(headers.get("content-length", "0"))
+    except ValueError:
+        raise _BadRequest("malformed Content-Length")
+    body = await reader.readexactly(length) if length > 0 else b""
+    return _Request(method, target, headers, body)
+
+
+class AsyncScorerServer:
+    """The event-loop server over a `ScorerService` (or `ReplicaSet`
+    facade). Two run modes: `serve_forever` (module function) blocks the
+    calling thread on its own ``asyncio.run`` for the CLI, while
+    `start()` / `close()` run the loop on a background thread so tests and
+    bench harnesses drive it like the threaded `make_server`."""
+
+    def __init__(
+        self, service: ScorerService, host: str = "127.0.0.1", port: int = 0
+    ):
+        self.service = service
+        self._host = host
+        self._port = port
+        self._bound_port: int | None = None
+        self._server: asyncio.base_events.Server | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._start_error: BaseException | None = None
+        self._conn_tasks: set[asyncio.Task] = set()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def start_async(self) -> "AsyncScorerServer":
+        """Bind inside an already-running loop (the CLI path)."""
+        self._server = await asyncio.start_server(
+            self._serve_connection, self._host, self._port
+        )
+        self._bound_port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    def start(self) -> "AsyncScorerServer":
+        """Background-thread mode: spin up a dedicated event loop, bind,
+        and return once the port is live — the async stand-in for
+        ``threading.Thread(target=httpd.serve_forever)``."""
+        self._loop = asyncio.new_event_loop()
+        started = threading.Event()
+
+        def _run() -> None:
+            asyncio.set_event_loop(self._loop)
+            try:
+                self._loop.run_until_complete(self.start_async())
+            except BaseException as exc:  # surface bind failures to start()
+                self._start_error = exc
+                started.set()
+                return
+            started.set()
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(
+            target=_run, daemon=True, name="asyncio-http"
+        )
+        self._thread.start()
+        if not started.wait(timeout=30.0):
+            raise RuntimeError("asyncio server failed to start within 30s")
+        if self._start_error is not None:
+            raise self._start_error
+        return self
+
+    @property
+    def port(self) -> int:
+        if self._bound_port is None:
+            raise RuntimeError("server is not started")
+        return self._bound_port
+
+    def close(self) -> None:
+        """Stop accepting, drain the loop, join the thread (background-thread
+        mode only). The service is NOT closed — the caller owns it."""
+        loop, thread = self._loop, self._thread
+        if loop is None:
+            return
+
+        async def _shutdown() -> None:
+            if self._server is not None:
+                self._server.close()
+                await self._server.wait_closed()
+            # idle keep-alive connections park their task in _read_request
+            # forever — cancel them so the loop drains clean
+            for task in list(self._conn_tasks):
+                task.cancel()
+            if self._conn_tasks:
+                await asyncio.gather(
+                    *self._conn_tasks, return_exceptions=True
+                )
+
+        with contextlib.suppress(Exception):
+            asyncio.run_coroutine_threadsafe(_shutdown(), loop).result(
+                timeout=10.0
+            )
+        loop.call_soon_threadsafe(loop.stop)
+        if thread is not None:
+            thread.join(timeout=10.0)
+        loop.close()
+        self._loop = self._thread = None
+
+    # -- connection / middleware ----------------------------------------------
+
+    async def _serve_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """One task per connection; requests on it are sequential (HTTP/1.1
+        keep-alive, no pipelining)."""
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+        try:
+            while True:
+                try:
+                    req = await _read_request(reader)
+                except _BadRequest as exc:
+                    await self._protocol_error(writer, str(exc))
+                    break
+                except (
+                    asyncio.IncompleteReadError,
+                    ConnectionError,
+                    asyncio.LimitOverrunError,
+                ):
+                    break
+                if req is None:
+                    break
+                if not await self._dispatch_request(req, writer):
+                    break
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            with contextlib.suppress(Exception):
+                writer.close()
+                await writer.wait_closed()
+
+    async def _protocol_error(
+        self, writer: asyncio.StreamWriter, detail: str
+    ) -> None:
+        """Pre-route 400: the request never parsed, so there is no route,
+        request id, or span to attribute it to."""
+        data = json.dumps({"detail": detail, "error": "bad_request"}).encode()
+        head = (
+            f"HTTP/1.1 400 Bad Request\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(data)}\r\n"
+            f"Connection: close\r\n\r\n"
+        ).encode("latin-1")
+        with contextlib.suppress(Exception):
+            writer.write(head + data)
+            await writer.drain()
+
+    async def _dispatch_request(
+        self, req: _Request, writer: asyncio.StreamWriter
+    ) -> bool:
+        """Per-request envelope — the same middleware as the threaded
+        adapter's ``_handle``: request-id context, a root ``http.request``
+        span (whose id is the request's trace id), typed-error mapping,
+        latency observation, flight recording, structured error log."""
+        service = self.service
+        split = urlsplit(req.target)
+        st = _State(writer)
+        st.route_path = split.path
+        st.query = parse_qs(split.query)
+        st.keep_alive = req.headers.get("connection", "").lower() != "close"
+        route = split.path if split.path in _KNOWN_ROUTES else "unmatched"
+        with request_context(req.headers.get("x-request-id") or None) as rid:
+            st.request_id = rid
+            with collect_phases() as phases, default_tracer().span(
+                "http.request", route=route, method=req.method, request_id=rid
+            ) as root:
+                try:
+                    if req.method == "POST":
+                        await self._post(st, req)
+                    elif req.method == "GET":
+                        await self._get(st, req)
+                    else:
+                        await self._send(
+                            st,
+                            501,
+                            {
+                                "detail": (
+                                    f"Unsupported method ({req.method!r})"
+                                ),
+                                "error": "unsupported_method",
+                            },
+                        )
+                except RequestError as e:
+                    await self._send(st, *error_response(e))
+                except ConnectionError:
+                    raise  # peer is gone: nothing left to answer
+                except Exception as e:
+                    await self._send(
+                        st,
+                        500,
+                        {
+                            "detail": f"Internal server error: {e}",
+                            "error": "internal",
+                        },
+                    )
+            duration_s = root.duration_s or 0.0
+            status = st.status if st.status is not None else 500
+            service.observe_request(
+                route,
+                status,
+                duration_s,
+                code=st.error_code,
+                trace_id=root.trace_id,
+            )
+            if route not in META_ROUTES:
+                service.flight.record(
+                    request_id=rid,
+                    trace_id=root.trace_id,
+                    route=route,
+                    method=req.method,
+                    status=status,
+                    duration_s=duration_s,
+                    code=st.error_code,
+                    phases=phases.phases,
+                )
+            if status >= 400:
+                _LOG.warning(
+                    "request_error",
+                    method=req.method,
+                    route=route,
+                    status=status,
+                    code=st.error_code or "error",
+                    duration_ms=round(duration_s * 1000.0, 3),
+                    trace_id=root.trace_id,
+                    span_id=root.span_id,
+                )
+        return st.keep_alive
+
+    # -- response plumbing -----------------------------------------------------
+
+    async def _send_bytes(
+        self,
+        st: _State,
+        code: int,
+        data: bytes,
+        content_type: str,
+        headers: dict | None = None,
+    ) -> None:
+        st.status = code
+        lines = [
+            f"HTTP/1.1 {code} {_REASONS.get(code, 'Unknown')}",
+            f"Content-Type: {content_type}",
+            f"Content-Length: {len(data)}",
+        ]
+        if st.request_id:
+            lines.append(f"X-Request-ID: {st.request_id}")
+        for name, value in (headers or {}).items():
+            lines.append(f"{name}: {value}")
+        lines.append(
+            "Connection: keep-alive" if st.keep_alive else "Connection: close"
+        )
+        st.writer.write(
+            ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1") + data
+        )
+        await st.writer.drain()
+
+    async def _send(
+        self, st: _State, code: int, obj, headers: dict | None = None
+    ) -> None:
+        if code >= 400 and isinstance(obj, dict):
+            st.error_code = obj.get("error")
+        if st.route_path in META_ROUTES:
+            await self._send_bytes(
+                st, code, json.dumps(obj).encode(), "application/json", headers
+            )
+            return
+        # data-plane responses: encoding + socket write (incl. drain's
+        # backpressure wait) is the "serialize" phase of the breakdown
+        with self.service.phase("serialize"):
+            await self._send_bytes(
+                st, code, json.dumps(obj).encode(), "application/json", headers
+            )
+
+    @staticmethod
+    def _json_body(body: bytes):
+        try:
+            return json.loads(body.decode() or "{}")
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            raise ValidationError("body is not valid JSON")
+
+    # -- routes ----------------------------------------------------------------
+
+    async def _post(self, st: _State, req: _Request) -> None:
+        service = self.service
+        body = req.body
+        if st.route_path == "/admin/reload":
+            # Admin plane: never gated by scoring admission — an operator
+            # must be able to swap in a fixed model while the data plane is
+            # shedding. Restore + compile are blocking, so the swap runs on
+            # the executor and the loop keeps serving meanwhile.
+            payload = self._json_body(body)
+            if not isinstance(payload, dict):
+                raise ValidationError("body must be a JSON object")
+            result = await _in_executor(
+                service.reload_from_store, model_key=payload.get("model_key")
+            )
+            if result["status"] == "ok":
+                await self._send(st, 200, result)
+            else:
+                await self._send(
+                    st,
+                    500,
+                    {
+                        "detail": f"reload rolled back: {result['error']}",
+                        "error": "reload_failed",
+                        "status": result["status"],
+                        "model_key": result["model_key"],
+                    },
+                )
+            return
+        if st.route_path == "/admin/promote":
+            payload = self._json_body(body)
+            force = isinstance(payload, dict) and bool(
+                payload.get("force", False)
+            )
+            await self._send(
+                st, 200, await _in_executor(service.promote_canary, force=force)
+            )
+            return
+        if st.route_path == "/admin/rollback":
+            payload = self._json_body(body)
+            reason = (
+                str(payload.get("reason", "manual"))
+                if isinstance(payload, dict)
+                else "manual"
+            )
+            await self._send(
+                st,
+                200,
+                await _in_executor(service.rollback_model, reason=reason),
+            )
+            return
+        if st.route_path == "/predict":
+            # The admission slot brackets the whole await — same atomicity
+            # as the threaded adapter bracketing its blocking call; the
+            # contextmanager's release runs on the loop thread either way.
+            with service.admission.admit():
+                resp = await service.predict_single_async(
+                    self._json_body(body)
+                )
+                await self._send(st, 200, resp)
+        elif st.route_path == "/predict_bulk_csv":
+            with service.admission.admit():
+                try:
+                    csv_bytes = _extract_csv(
+                        body, req.headers.get("content-type", "")
+                    )
+                    await self._send(
+                        st,
+                        200,
+                        await service.predict_bulk_csv_async(csv_bytes),
+                    )
+                except RequestError:
+                    raise  # typed errors keep their status (422/413/504)
+                except Exception as e:
+                    # parity with the reference's try/except -> HTTP 500 on
+                    # the bulk route (cobalt_fast_api.py:124-126)
+                    await self._send(
+                        st,
+                        500,
+                        {
+                            "detail": f"Bulk prediction failed: {e}",
+                            "error": "bulk_failed",
+                        },
+                    )
+        elif st.route_path == "/feature_importance_bulk":
+            with service.admission.admit():
+                payload = self._json_body(body)  # malformed JSON -> 422
+                try:
+                    await self._send(
+                        st,
+                        200,
+                        await service.feature_importance_bulk_async(payload),
+                    )
+                except ValidationError as e:
+                    # this route 400s on empty data in the reference
+                    # (cobalt_fast_api.py:131), not 422
+                    await self._send(st, 400, e.body())
+        else:
+            await self._send(st, 404, {"detail": "Not Found"})
+
+    def _query_int(self, st: _State, name: str, default: int) -> int:
+        raw = st.query.get(name, [None])[-1]
+        if raw is None:
+            return default
+        try:
+            return int(raw)
+        except ValueError:
+            raise ValidationError(f"query param {name!r} must be an integer")
+
+    def _query_limit(self, st: _State, legacy: str, default: int) -> int:
+        """``?limit=`` (``?n=``/``?k=`` still accepted), bounded."""
+        name = "limit" if "limit" in st.query else legacy
+        return validate_debug_limit(self._query_int(st, name, default), name)
+
+    async def _get(self, st: _State, req: _Request) -> None:
+        service = self.service
+        path = st.route_path
+        if path == "/healthz":
+            await self._send(st, 200, service.health())
+        elif path == "/readyz":
+            ready, payload = service.ready()
+            # degraded-but-scorable is still 200: readiness gates traffic
+            # on the probability contract, not the SHAP enrichment
+            await self._send(st, 200 if ready else 503, payload)
+        elif path == "/metrics":
+            # content negotiation: the OpenMetrics variant carries exemplar
+            # trace ids on latency buckets; the classic 0.0.4 format (the
+            # default, what CI's strict parser pins) does not
+            accept = req.headers.get("accept", "")
+            openmetrics = "application/openmetrics-text" in accept
+            await self._send_bytes(
+                st,
+                200,
+                service.registry.render(openmetrics=openmetrics).encode(),
+                OPENMETRICS_CONTENT_TYPE
+                if openmetrics
+                else EXPOSITION_CONTENT_TYPE,
+            )
+        elif path == "/slo":
+            if service.slo is None:
+                await self._send(
+                    st,
+                    404,
+                    {"detail": "SLO engine disabled", "error": "slo_disabled"},
+                )
+            else:
+                await self._send(st, 200, service.slo.evaluate(force=True))
+        elif path == "/drift":
+            await self._send(st, 200, service.drift_report())
+        elif path == "/debug/requests":
+            n = self._query_limit(st, "n", 50)
+            phase = validate_debug_phase(st.query.get("phase", [None])[-1])
+            await self._send(
+                st,
+                200,
+                {
+                    "recent": service.flight.records(n, phase),
+                    "errors": service.flight.errors(n, phase),
+                    "stats": service.flight.stats(),
+                },
+            )
+        elif path == "/debug/slowest":
+            k = self._query_limit(st, "k", service.flight.top_k)
+            phase = validate_debug_phase(st.query.get("phase", [None])[-1])
+            await self._send(
+                st,
+                200,
+                {
+                    "slowest": service.flight.slowest(k, phase),
+                    "stats": service.flight.stats(),
+                },
+            )
+        elif path == "/debug/programs":
+            await self._send(st, 200, debug_programs_payload())
+        elif path == "/debug/trace":
+            await self._send_bytes(
+                st,
+                200,
+                render_chrome_trace(default_tracer()).encode(),
+                TRACE_CONTENT_TYPE,
+            )
+        else:
+            await self._send(st, 404, {"detail": "Not Found"})
+
+
+def make_async_server(
+    service: ScorerService, host: str = "127.0.0.1", port: int = 0
+) -> AsyncScorerServer:
+    """Build-and-start the background-thread server; port 0 picks a free
+    port — the async mirror of `http_stdlib.make_server` for in-process
+    tests and bench harnesses. Callers own ``.close()`` (and the service)."""
+    return AsyncScorerServer(service, host, port).start()
+
+
+def serve_forever(
+    service: ScorerService, host: str = "0.0.0.0", port: int = 8000
+) -> None:
+    """Blocking server loop — the asyncio replacement for the threaded
+    adapter's `serve_forever` (same contract: drains the service at exit)."""
+
+    async def _main() -> None:
+        server = await AsyncScorerServer(service, host, port).start_async()
+        async with server._server:
+            await server._server.serve_forever()
+
+    try:
+        asyncio.run(_main())
+    except KeyboardInterrupt:
+        pass
+    finally:
+        # Drain the micro-batch scheduler so queued requests resolve before
+        # the process exits (late arrivals fall back to direct dispatch).
+        service.close()

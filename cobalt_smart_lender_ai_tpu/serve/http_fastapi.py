@@ -12,6 +12,14 @@ both expose the same admin plane (``POST /admin/reload`` hot swap,
 training loop), ``GET /drift`` PSI report, and ``GET /metrics`` Prometheus
 exposition.
 
+The endpoints are natively async (no threadpool offload): a scoring
+request's coroutine runs on uvicorn's event loop and suspends on the
+micro-batcher's wrapped future (`ScorerService.predict_single_async`) —
+the same one-event-loop request path as `http_asyncio.py`, rather than
+FastAPI's default sync-handler-in-a-threadpool model. Blocking admin work
+(hot reload = restore + compile) runs on the default executor so the data
+plane keeps serving during a swap.
+
 Telemetry (mirrored in `http_stdlib.py`): each route body runs inside
 `_track(route, ...)` — a per-request envelope that binds the request-id
 context (honoring the client's ``X-Request-ID``, echoing the id on the
@@ -204,13 +212,13 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
                     )
 
     @app.post("/predict")
-    def predict_single(
+    async def predict_single(
         input_data: SingleInput, request: Request = None, response: Response = None
     ):
         with _track("/predict", request, response):
             try:
                 with state["service"].admission.admit():
-                    return state["service"].predict_single(
+                    return await state["service"].predict_single_async(
                         input_data.model_dump(by_alias=True)
                     )
             except RequestError as e:
@@ -226,7 +234,7 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
             body = await file.read()
             try:
                 with state["service"].admission.admit():
-                    return state["service"].predict_bulk_csv(body)
+                    return await state["service"].predict_bulk_csv_async(body)
             except RequestError as e:
                 _raise_typed(e)
             except Exception as e:
@@ -237,13 +245,13 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
                 raise exc
 
     @app.post("/feature_importance_bulk")
-    def feature_importance_bulk(
+    async def feature_importance_bulk(
         data: BulkInput, request: Request = None, response: Response = None
     ):
         with _track("/feature_importance_bulk", request, response):
             try:
                 with state["service"].admission.admit():
-                    return state["service"].feature_importance_bulk(
+                    return await state["service"].feature_importance_bulk_async(
                         data.model_dump()
                     )
             except ValidationError as e:
@@ -256,15 +264,20 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
                 _raise_typed(e)
 
     @app.post("/admin/reload")
-    def admin_reload(
+    async def admin_reload(
         data: ReloadInput, request: Request = None, response: Response = None
     ):
         # Admin plane: never gated by scoring admission — an operator must be
-        # able to swap in a fixed model while the data plane is shedding.
+        # able to swap in a fixed model while the data plane is shedding. The
+        # swap (restore + compile) is blocking, so it runs on the executor
+        # and the loop keeps scoring meanwhile.
+        from cobalt_smart_lender_ai_tpu.serve.service import _in_executor
+
         with _track("/admin/reload", request, response):
             try:
-                result = state["service"].reload_from_store(
-                    model_key=data.model_key
+                result = await _in_executor(
+                    state["service"].reload_from_store,
+                    model_key=data.model_key,
                 )
             except RequestError as e:  # breaker open -> 503 + Retry-After
                 _raise_typed(e)
@@ -275,7 +288,7 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
             return result
 
     @app.post("/admin/promote")
-    def admin_promote(
+    async def admin_promote(
         data: PromoteInput = None, request: Request = None, response: Response = None
     ):
         # Admin plane, same as /admin/reload. A gate rejection keeps its
@@ -284,10 +297,12 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
             from cobalt_smart_lender_ai_tpu.reliability.errors import (
                 PromotionRejected,
             )
+            from cobalt_smart_lender_ai_tpu.serve.service import _in_executor
 
             try:
-                return state["service"].promote_canary(
-                    force=bool(data.force) if data is not None else False
+                return await _in_executor(
+                    state["service"].promote_canary,
+                    force=bool(data.force) if data is not None else False,
                 )
             except PromotionRejected as e:
                 exc = HTTPException(status_code=e.status, detail=e.body())
@@ -297,13 +312,16 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
                 _raise_typed(e)
 
     @app.post("/admin/rollback")
-    def admin_rollback(
+    async def admin_rollback(
         data: RollbackInput = None, request: Request = None, response: Response = None
     ):
         with _track("/admin/rollback", request, response):
+            from cobalt_smart_lender_ai_tpu.serve.service import _in_executor
+
             try:
-                return state["service"].rollback_model(
-                    reason=data.reason if data is not None else "manual"
+                return await _in_executor(
+                    state["service"].rollback_model,
+                    reason=data.reason if data is not None else "manual",
                 )
             except RequestError as e:
                 _raise_typed(e)

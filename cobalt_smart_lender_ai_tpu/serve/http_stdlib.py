@@ -1,5 +1,16 @@
 """Zero-dependency HTTP adapter over `ScorerService` (stdlib http.server).
 
+DEPRECATED — rollback path only. The asyncio event-loop adapter
+(`serve.http_asyncio`) replaced this thread-per-connection server as the
+default zero-dependency frontend; select this one with
+``--serve-impl threaded`` if the asyncio core misbehaves in your
+deployment. It is kept for exactly one release — a parity test
+(tests/test_async_serve.py) pins both adapters to byte-identical response
+bodies until removal. The shared route helpers defined here
+(`_KNOWN_ROUTES`, `validate_debug_limit`, `validate_debug_phase`,
+`debug_programs_payload`, `_extract_csv`) are imported by the asyncio
+adapter and will move there when this module is dropped.
+
 This environment has no fastapi/uvicorn; the serving contract still has to be
 reachable over real HTTP (the reference serves on port 8000,
 `cobalt_fast_api.py:148-149`). Routes, methods, status codes and JSON bodies

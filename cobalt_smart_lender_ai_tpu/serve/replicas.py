@@ -345,15 +345,46 @@ class ReplicaSet:
             can.tap(resp["input_row"], resp["prob_default"], None)
         return resp
 
+    async def predict_single_async(
+        self, payload: Mapping[str, Any], *, deadline=None
+    ) -> dict:
+        """Coroutine-context routing: `_pick` / `_routed` take plain locks
+        (never block on I/O), so the least-loaded router works unchanged on
+        the event loop — the in-flight count brackets the full await, and
+        the fleet canary taps from the loop thread (a bounded non-blocking
+        append; serve/canary.py)."""
+        with self._routed() as rep:
+            resp = await rep.predict_single_async(payload, deadline=deadline)
+        if self._model_identity is not None:
+            resp["model_version"] = self._model_identity["version"]
+        can = self.canary
+        if can is not None:
+            can.tap(resp["input_row"], resp["prob_default"], None)
+        return resp
+
     def predict_bulk_csv(self, csv_bytes: bytes, *, deadline=None) -> dict:
         with self._routed() as rep:
             return rep.predict_bulk_csv(csv_bytes, deadline=deadline)
+
+    async def predict_bulk_csv_async(
+        self, csv_bytes: bytes, *, deadline=None
+    ) -> dict:
+        with self._routed() as rep:
+            return await rep.predict_bulk_csv_async(csv_bytes, deadline=deadline)
 
     def feature_importance_bulk(
         self, payload: Mapping[str, Any], *, deadline=None
     ) -> dict:
         with self._routed() as rep:
             return rep.feature_importance_bulk(payload, deadline=deadline)
+
+    async def feature_importance_bulk_async(
+        self, payload: Mapping[str, Any], *, deadline=None
+    ) -> dict:
+        with self._routed() as rep:
+            return await rep.feature_importance_bulk_async(
+                payload, deadline=deadline
+            )
 
     def predict_proba(self, X: np.ndarray, deadline=None) -> np.ndarray:
         with self._routed() as rep:

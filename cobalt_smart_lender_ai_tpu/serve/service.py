@@ -45,8 +45,11 @@ payloads short-circuit through a content-hash LRU score cache
 
 from __future__ import annotations
 
+import asyncio
 import collections
 import contextlib
+import contextvars
+import functools
 import io as _io
 import math
 import threading
@@ -76,6 +79,7 @@ from cobalt_smart_lender_ai_tpu.reliability.breaker import (
 )
 from cobalt_smart_lender_ai_tpu.reliability.deadline import (
     Deadline,
+    await_under_deadline,
     start_deadline,
 )
 from cobalt_smart_lender_ai_tpu.reliability.errors import (
@@ -92,6 +96,7 @@ from cobalt_smart_lender_ai_tpu.telemetry import (
     default_objectives,
     default_tracer,
     get_logger,
+    request_context,
 )
 
 _LOG = get_logger("cobalt.serve")
@@ -107,6 +112,30 @@ __all__ = [
     "ValidationError",
     "validate_single_input",
 ]
+
+
+def _retrieve_silently(fut: "asyncio.Future") -> None:
+    """Done-callback that marks an abandoned future's exception retrieved.
+
+    A loop-scheduled deadline (`await_under_deadline`) resolves the request
+    504 and walks away; the micro-batch worker still resolves the underlying
+    future later — usually with its own `DeadlineExceeded`. Without this the
+    loop would log "exception was never retrieved" for every queued 504."""
+    if not fut.cancelled():
+        fut.exception()
+
+
+def _in_executor(func: Callable, *args, **kwargs):
+    """Run a blocking callable on the loop's default executor with the
+    calling task's contextvars (request id, span parent, phase accumulator)
+    carried across the thread hop — the bounded-pool escape hatch for work
+    that cannot suspend (pandas parse, direct-path device dispatch), as
+    opposed to the threaded adapter's thread per request."""
+    loop = asyncio.get_running_loop()
+    ctx = contextvars.copy_context()
+    return loop.run_in_executor(
+        None, functools.partial(ctx.run, functools.partial(func, *args, **kwargs))
+    )
 
 
 #: The serving request schema: every field of the reference's pydantic
@@ -606,6 +635,20 @@ class MicroBatcher:
             self._cond.notify_all()
         return fut
 
+    def submit_async(
+        self, row: Mapping[str, float], deadline: Deadline | None
+    ) -> "asyncio.Future":
+        """Awaitable mode of `submit`: same queue, same worker, same result
+        tuple — but the caller suspends on the event loop instead of parking
+        a thread on ``Future.result()``. The worker thread resolves the
+        concurrent future; ``asyncio.wrap_future`` wakes the awaiting
+        coroutine on its loop. Must be called from a running event loop."""
+        afut = asyncio.wrap_future(self.submit(row, deadline))
+        # A loop-scheduled 504 abandons this future; the worker still
+        # resolves it — retrieve so the abandonment is silent.
+        afut.add_done_callback(_retrieve_silently)
+        return afut
+
     def queue_depth(self) -> int:
         with self._cond:
             return len(self._queue)
@@ -698,8 +741,12 @@ class MicroBatcher:
         live = []
         for row, dl, fut, enq_t, rid in batch:
             if dl is not None and dl.expired():
+                # Counted here exactly once even when a loop-scheduled
+                # timeout already answered the client (the abandoned future
+                # is resolved, not cancelled, so the accounting is single).
                 self._m_expired.labels(where="queued").inc()
-                fut.set_exception(dl.exceeded("queued for micro-batch"))
+                if not fut.done():
+                    fut.set_exception(dl.exceeded("queued for micro-batch"))
             else:
                 live.append((row, dl, fut, enq_t, rid))
         if not live:
@@ -768,6 +815,8 @@ class MicroBatcher:
         self._m_batch_rows.observe(n)
         self._m_max_batch.set_max(n)
         for i, (_, dl, fut, enq_t, _) in enumerate(live):
+            if fut.done():
+                continue  # already resolved/cancelled: never overwrite
             if dl is not None and dl.expired():
                 # The dispatch itself cannot be interrupted; past the
                 # deadline the client is gone — 504, not a late 200 (the
@@ -1522,14 +1571,22 @@ class ScorerService:
 
     # -- endpoint handlers ----------------------------------------------------
 
-    def predict_single(
-        self, payload: Mapping[str, Any], *, deadline: Deadline | None = None
-    ) -> dict:
-        """`POST /predict` (cobalt_fast_api.py:96-108): probability + per-row
-        SHAP in the exact response shape. With the micro-batcher enabled the
-        request is coalesced with concurrent callers into one padded bucket
-        dispatch; otherwise it scores on its own `(1, F)` programs."""
-        dl = deadline if deadline is not None else self._new_deadline()
+    def _ingress_request_id(self):
+        """Mint a request id when no adapter did (in-process callers, bench
+        harnesses): the id captured at `MicroBatcher.submit` is the only join
+        key from a dispatch span back to its requests, so id-less ingress
+        must not leave ``"request_ids": []`` holes in the batch spans."""
+        if current_request_id() is None:
+            return request_context()
+        return contextlib.nullcontext(current_request_id())
+
+    def _predict_validate(
+        self, payload: Mapping[str, Any], dl: Deadline | None
+    ) -> tuple[Mapping[str, float], dict | None, bytes | None, Any]:
+        """Shared front half of both `predict_single` variants: schema
+        validation, the deadline's first checkpoint, and the content-hash
+        score-cache probe. Returns ``(row, cached_resp, cache_key,
+        cache_model)`` — a non-None ``cached_resp`` is the finished hit."""
         with self.phase("validate"):
             row = validate_single_input(payload)
             if dl is not None:
@@ -1560,53 +1617,144 @@ class ScorerService:
                 # The canary has no cache: a hit still shadow-scores, so the
                 # comparison window keeps filling under cache-friendly load.
                 self._canary_tap(row, prob, None)
-                return resp
+                return row, resp, cache_key, cache_model
             self._m_cache_misses.inc()
-        batcher = self.batcher
-        fut = None
-        if batcher is not None and not batcher.closed:
-            try:
-                fut = batcher.submit(row, dl)
-            except RuntimeError:
-                fut = None  # closed in the gap: score on the direct path
-        if fut is not None:
-            # raises the request's typed error (e.g. DeadlineExceeded -> 504)
-            prob, phis_row, base, shap_error, phases = fut.result()
-            # Phase attribution measured on the worker, recorded here on the
-            # request thread — where this request's flight accumulator and
-            # the phase histogram are in scope.
-            for phase_name, phase_s in phases.items():
-                self._observe_phase(phase_name, phase_s)
-            model = self._model
-            resp = {
-                "prob_default": prob,
-                "features": list(model.feature_names),
-                "input_row": dict(row),
-            }
-            if phis_row is not None:
-                resp["shap_values"] = phis_row
-                resp["base_value"] = base
-            else:
-                # same degrade contract as the direct path below
-                err = shap_error or "SHAP program unavailable"
-                if not self.config.reliability.degrade_shap:
-                    raise RuntimeError(err)
-                if model.shap_error is None:
-                    model.shap_error = err
-                resp["shap_values"] = None
-                resp["base_value"] = None
-                resp["degraded"] = True
-                self._m_shap_degraded.inc()
-            if cache_key is not None and resp.get("shap_values") is not None:
-                self._score_cache_put(
-                    cache_key,
-                    (resp["prob_default"], resp["shap_values"], resp["base_value"]),
-                    model=cache_model,
+        return row, None, cache_key, cache_model
+
+    def _finish_batched(
+        self,
+        row: Mapping[str, float],
+        result: tuple,
+        cache_key: bytes | None,
+        cache_model,
+    ) -> dict:
+        """Shared back half of both variants for a batcher-scored request:
+        turn the future's result tuple into the response contract."""
+        prob, phis_row, base, shap_error, phases = result
+        # Phase attribution measured on the worker, recorded here in the
+        # request's own context — where this request's flight accumulator
+        # and the phase histogram are in scope (thread or coroutine alike).
+        for phase_name, phase_s in phases.items():
+            self._observe_phase(phase_name, phase_s)
+        model = self._model
+        resp = {
+            "prob_default": prob,
+            "features": list(model.feature_names),
+            "input_row": dict(row),
+        }
+        if phis_row is not None:
+            resp["shap_values"] = phis_row
+            resp["base_value"] = base
+        else:
+            # same degrade contract as the direct path
+            err = shap_error or "SHAP program unavailable"
+            if not self.config.reliability.degrade_shap:
+                raise RuntimeError(err)
+            if model.shap_error is None:
+                model.shap_error = err
+            resp["shap_values"] = None
+            resp["base_value"] = None
+            resp["degraded"] = True
+            self._m_shap_degraded.inc()
+        if cache_key is not None and resp.get("shap_values") is not None:
+            self._score_cache_put(
+                cache_key,
+                (resp["prob_default"], resp["shap_values"], resp["base_value"]),
+                model=cache_model,
+            )
+        if self._model_identity is not None:
+            resp["model_version"] = self._model_identity["version"]
+        self._canary_tap(row, prob, phases.get("dispatch"))
+        return resp
+
+    def predict_single(
+        self, payload: Mapping[str, Any], *, deadline: Deadline | None = None
+    ) -> dict:
+        """`POST /predict` (cobalt_fast_api.py:96-108): probability + per-row
+        SHAP in the exact response shape. With the micro-batcher enabled the
+        request is coalesced with concurrent callers into one padded bucket
+        dispatch; otherwise it scores on its own `(1, F)` programs."""
+        with self._ingress_request_id():
+            dl = deadline if deadline is not None else self._new_deadline()
+            row, cached, cache_key, cache_model = self._predict_validate(
+                payload, dl
+            )
+            if cached is not None:
+                return cached
+            batcher = self.batcher
+            fut = None
+            if batcher is not None and not batcher.closed:
+                try:
+                    fut = batcher.submit(row, dl)
+                except RuntimeError:
+                    fut = None  # closed in the gap: score on the direct path
+            if fut is not None:
+                # blocks this thread; raises the request's typed error
+                # (e.g. DeadlineExceeded -> 504)
+                return self._finish_batched(
+                    row, fut.result(), cache_key, cache_model
                 )
-            if self._model_identity is not None:
-                resp["model_version"] = self._model_identity["version"]
-            self._canary_tap(row, prob, phases.get("dispatch"))
-            return resp
+            return self._predict_direct(row, dl, cache_key, cache_model)
+
+    async def predict_single_async(
+        self, payload: Mapping[str, Any], *, deadline: Deadline | None = None
+    ) -> dict:
+        """Awaitable `predict_single`: identical contract, but the request
+        coroutine suspends on the event loop from admission through batch
+        dispatch — no thread is parked on the future, and the deadline is a
+        loop-scheduled timer that resolves a queued 504 without a batch slot
+        (`reliability.deadline.await_under_deadline`). The rare direct path
+        (batcher off or closing) runs on the default executor so a device
+        dispatch never stalls the loop."""
+        with self._ingress_request_id():
+            dl = deadline if deadline is not None else self._new_deadline()
+            row, cached, cache_key, cache_model = self._predict_validate(
+                payload, dl
+            )
+            if cached is not None:
+                return cached
+            batcher = self.batcher
+            afut = None
+            if batcher is not None and not batcher.closed:
+                try:
+                    afut = batcher.submit_async(row, dl)
+                except RuntimeError:
+                    afut = None  # closed in the gap: score on the direct path
+            if afut is not None:
+                result = await await_under_deadline(
+                    afut, dl, "queued for micro-batch"
+                )
+                return self._finish_batched(row, result, cache_key, cache_model)
+            return await _in_executor(
+                self._predict_direct, row, dl, cache_key, cache_model
+            )
+
+    async def predict_bulk_csv_async(
+        self, csv_bytes: bytes, *, deadline: Deadline | None = None
+    ) -> dict:
+        """Awaitable `predict_bulk_csv`: the pandas parse and the sharded
+        bulk dispatch are inherently blocking, so the whole handler runs on
+        the default executor (a bounded pool — not a thread per request)
+        while the loop keeps serving other coroutines."""
+        return await _in_executor(
+            self.predict_bulk_csv, csv_bytes, deadline=deadline
+        )
+
+    async def feature_importance_bulk_async(
+        self, payload: Mapping[str, Any], *, deadline: Deadline | None = None
+    ) -> dict:
+        """Awaitable `feature_importance_bulk` — static booster gains, no
+        device dispatch, so it runs inline on the loop."""
+        return self.feature_importance_bulk(payload, deadline=deadline)
+
+    def _predict_direct(
+        self,
+        row: Mapping[str, float],
+        dl: Deadline | None,
+        cache_key: bytes | None,
+        cache_model,
+    ) -> dict:
+        """The un-coalesced path: this request's own `(1, F)` programs."""
         model = self._model
         with self.phase("dispatch") as dispatch_sp:
             x = model.row_array(row)

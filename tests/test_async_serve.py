@@ -1,0 +1,658 @@
+"""Asyncio-native serving core: one event loop from socket to batcher future.
+
+Pins the tentpole guarantees of `serve.http_asyncio` + the service's async
+mode:
+
+- `MicroBatcher.submit_async` coalesces awaiting coroutines into one device
+  dispatch exactly like thread-blocked `submit` callers (deterministic via
+  `pause`);
+- a queued request whose deadline expires resolves its 504 on the event
+  loop with NO batch slot consumed and NO thread parked — the batcher can be
+  wedged solid and the client still gets its typed answer on time;
+- a hot reload racing an in-flight awaited batch never mixes models inside
+  one batch;
+- the error taxonomy (422/400/404/429 shed/503 circuit_open/500
+  reload_failed) is IDENTICAL between the asyncio adapter and the
+  deprecated threaded rollback adapter, and scoring bodies are
+  byte-identical between the two;
+- the /readyz, /slo, /debug/*, /metrics (classic + OpenMetrics) contracts
+  hold unchanged on the asyncio adapter;
+- request ids minted at ingress for id-less clients join across logs,
+  flight records, batch spans and exemplars (the ``"request_ids": []``
+  regression);
+- chaos soak (marked ``slow`` + ``faults``, CI faults job): store faults +
+  latency + concurrent hot swaps against the asyncio adapter produce zero
+  untyped 500s.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cobalt_smart_lender_ai_tpu.config import ReliabilityConfig, ServeConfig
+from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+from cobalt_smart_lender_ai_tpu.reliability import (
+    DeadlineExceeded,
+    FaultInjectingStore,
+    FaultSpec,
+    start_deadline,
+)
+from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
+from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+
+def _cfg(**kw) -> ServeConfig:
+    rel = {
+        k: kw.pop(k)
+        for k in list(kw)
+        if k in ReliabilityConfig.__dataclass_fields__
+    }
+    base = dict(prewarm_all_buckets=False)
+    base.update(kw)
+    if rel:
+        base["reliability"] = ReliabilityConfig(**rel)
+    return ServeConfig(**base)
+
+
+def _valid_payload() -> dict:
+    from cobalt_smart_lender_ai_tpu.data import schema
+    from cobalt_smart_lender_ai_tpu.serve.service import SINGLE_INPUT_FIELDS
+
+    return {
+        canonical: (1 if canonical in schema.SERVING_INT_FEATURES else 1.5)
+        for canonical in SINGLE_INPUT_FIELDS.values()
+    }
+
+
+@contextlib.contextmanager
+def _serving(impl: str, service):
+    """Run ``service`` behind one adapter; yields the base URL."""
+    if impl == "asyncio":
+        server = make_async_server(service)
+        try:
+            yield f"http://127.0.0.1:{server.port}"
+        finally:
+            server.close()
+    else:
+        httpd = make_server(service)
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        try:
+            yield f"http://127.0.0.1:{httpd.server_address[1]}"
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+def _request(url, data=None, content_type="application/json", headers=None):
+    req = urllib.request.Request(
+        url, data=data, method="POST" if data is not None else "GET"
+    )
+    if data is not None:
+        req.add_header("Content-Type", content_type)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+# --- awaitable-future batcher mode --------------------------------------------
+
+
+def test_submit_async_coalesces_under_paused_batcher(serving_artifact):
+    """N coroutines awaiting `submit_async` under a paused batcher all land
+    in ONE dispatched batch when the pause lifts — the awaitable mode feeds
+    the same queue/worker as the thread-blocking mode."""
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(
+        store, _cfg(microbatch_enabled=True, microbatch_max_wait_ms=5.0)
+    )
+    try:
+        payload = _valid_payload()
+        before = svc.batcher.stats()
+
+        async def drive():
+            with svc.batcher.pause():
+                tasks = [
+                    asyncio.ensure_future(svc.predict_single_async(payload))
+                    for _ in range(5)
+                ]
+                # let every coroutine run to its await (enqueue its row)
+                for _ in range(20):
+                    await asyncio.sleep(0.005)
+                    if svc.batcher.queue_depth() == 5:
+                        break
+                assert svc.batcher.queue_depth() == 5
+            return await asyncio.gather(*tasks)
+
+        resps = asyncio.run(drive())
+        assert len(resps) == 5
+        assert len({r["prob_default"] for r in resps}) == 1
+        after = svc.batcher.stats()
+        assert after["batches"] == before["batches"] + 1
+        assert after["coalesced_rows"] == before["coalesced_rows"] + 5
+    finally:
+        svc.close()
+
+
+def test_queued_deadline_504_resolves_without_batch_slot(serving_artifact):
+    """A deadline expiring while the request sits in the batcher queue must
+    resolve the awaiting coroutine with a 504 ON TIME — while the batcher is
+    still wedged (paused), so no dispatch and no worker involvement produced
+    the answer, and no OS thread sat parked on `Future.result`. The worker
+    later counts the expiry exactly once when it finally drains the queue."""
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store, _cfg(microbatch_enabled=True))
+    try:
+        payload = _valid_payload()
+        threads_before = threading.active_count()
+
+        async def drive():
+            with svc.batcher.pause():
+                dl = start_deadline(0.2)
+                t0 = time.monotonic()
+                with pytest.raises(DeadlineExceeded) as ei:
+                    await svc.predict_single_async(payload, deadline=dl)
+                elapsed = time.monotonic() - t0
+                # resolved by the loop timer, not by a batch slot
+                assert svc.batcher.stats()["batches"] == 0
+                return ei.value, elapsed
+
+        exc, elapsed = asyncio.run(drive())
+        assert exc.status == 504
+        assert "queued" in str(exc.detail)
+        assert elapsed < 2.0  # loop timer, not a 30s default deadline
+        assert threading.active_count() <= threads_before + 1
+        # pause lifted: the worker drains the stale entry and accounts it
+        deadline_drain = time.monotonic() + 5.0
+        while (
+            svc.batcher.stats()["expired_in_queue"] < 1
+            and time.monotonic() < deadline_drain
+        ):
+            time.sleep(0.01)
+        assert svc.batcher.stats()["expired_in_queue"] == 1
+        # and the service still scores cleanly afterwards
+        resp = svc.predict_single(payload)
+        assert 0.0 <= resp["prob_default"] <= 1.0
+    finally:
+        svc.close()
+
+
+def test_hot_reload_mid_await_never_mixes_models(tmp_path, serving_artifact):
+    """Requests awaiting in the batcher queue when a hot reload lands are
+    scored wholly by ONE model — the batch snapshots its model under the
+    dispatch lock, so a swap mid-await can delay a batch but never split
+    it across models."""
+    shared, _ = serving_artifact
+    art = GBDTArtifact.load(shared, "models/gbdt/model_tree")
+    store = ObjectStore(str(tmp_path / "lake"))
+    art.save(store, "models/gbdt/model_tree")
+    # all-zero leaves: margin 0 -> P(default) exactly 0.5 for any input
+    import jax.numpy as jnp
+
+    zeroed = dataclasses.replace(
+        art,
+        forest=dataclasses.replace(
+            art.forest, leaf_value=jnp.zeros_like(art.forest.leaf_value)
+        ),
+    )
+    zeroed.save(store, "models/gbdt/v2")
+
+    svc = ScorerService.from_store(
+        store, _cfg(microbatch_enabled=True, score_cache_size=0)
+    )
+    try:
+        payload = _valid_payload()
+        old_prob = svc.predict_single(payload)["prob_default"]
+        assert old_prob != 0.5  # otherwise the swap would be unobservable
+
+        async def drive():
+            from cobalt_smart_lender_ai_tpu.serve.service import _in_executor
+
+            with svc.batcher.pause():
+                tasks = [
+                    asyncio.ensure_future(svc.predict_single_async(payload))
+                    for _ in range(4)
+                ]
+                for _ in range(40):
+                    await asyncio.sleep(0.005)
+                    if svc.batcher.queue_depth() == 4:
+                        break
+                assert svc.batcher.queue_depth() == 4
+                # the reload parks on the batcher's pause gate; its own
+                # pause count keeps the worker held until publish completes
+                reload_fut = _in_executor(
+                    svc.reload_from_store, model_key="models/gbdt/v2"
+                )
+                await asyncio.sleep(0.05)
+                assert not reload_fut.done()
+            resps = await asyncio.gather(*tasks)
+            assert (await reload_fut)["status"] == "ok"
+            return resps
+
+        resps = asyncio.run(drive())
+        probs = {r["prob_default"] for r in resps}
+        assert len(probs) == 1, f"one batch scored by two models: {probs}"
+        assert probs == {0.5}  # publish happened before the batch dispatched
+    finally:
+        svc.close()
+
+
+# --- taxonomy + byte parity against the threaded rollback adapter -------------
+
+
+def _taxonomy_trace(impl: str, tmp_path, serving_artifact) -> list[tuple]:
+    shared, _ = serving_artifact
+    art = GBDTArtifact.load(shared, "models/gbdt/model_tree")
+    store = ObjectStore(str(tmp_path / f"lake-{impl}"))
+    art.save(store, "models/gbdt/model_tree")
+    flaky = FaultInjectingStore(store, faults={})
+    svc = ScorerService.from_store(
+        flaky,
+        _cfg(
+            microbatch_enabled=True,
+            max_in_flight=1,
+            breaker_failure_threshold=3,
+            breaker_reset_s=60.0,
+        ),
+    )
+    ok = json.dumps(_valid_payload()).encode()
+    trace: list[tuple] = []
+
+    def probe(path, data=None, ct="application/json"):
+        status, body, headers = _request(base + path, data, ct)
+        parsed = json.loads(body.decode()) if body else {}
+        trace.append(
+            (path, status, parsed.get("error"), "Retry-After" in headers)
+        )
+        return status, parsed
+
+    try:
+        with _serving(impl, svc) as base:
+            probe("/predict", ok)  # 200
+            probe("/predict", b"{}")  # 422 invalid_input
+            probe("/feature_importance_bulk", b'{"data": []}')  # 400
+            probe("/nope", b"{}")  # 404
+            slot = svc.admission.admit()
+            slot.__enter__()
+            try:
+                probe("/predict", ok)  # 429 shed + Retry-After
+            finally:
+                slot.__exit__(None, None, None)
+            flaky.faults["get"] = FaultSpec(fail_after=0)
+            for _ in range(3):
+                probe("/admin/reload", b"{}")  # 500 reload_failed x3
+            probe("/admin/reload", b"{}")  # 503 circuit_open + Retry-After
+    finally:
+        svc.close()
+    return trace
+
+
+def test_error_taxonomy_identical_across_adapters(tmp_path, serving_artifact):
+    """Admission 429, breaker 503, and the 4xx taxonomy present identical
+    (status, error-code, Retry-After) sequences on the asyncio adapter and
+    the threaded rollback adapter."""
+    traces = {
+        impl: _taxonomy_trace(impl, tmp_path, serving_artifact)
+        for impl in ("asyncio", "threaded")
+    }
+    assert traces["asyncio"] == traces["threaded"]
+    statuses = [s for _, s, _, _ in traces["asyncio"]]
+    assert statuses == [200, 422, 400, 404, 429, 500, 500, 500, 503]
+    codes = [c for _, _, c, _ in traces["asyncio"]]
+    assert codes[1] == "invalid_input"
+    assert codes[4] == "shed"
+    assert codes[5:8] == ["reload_failed"] * 3
+    assert codes[8] == "circuit_open"
+    retry_after = [ra for _, _, _, ra in traces["asyncio"]]
+    assert retry_after[4] and retry_after[8]  # shed + circuit_open carry it
+
+
+def test_adapters_serve_byte_identical_bodies(serving_artifact):
+    """The rollback guarantee: until the threaded adapter is removed, both
+    frontends over one service return byte-for-byte identical bodies for
+    every deterministic route."""
+    from cobalt_smart_lender_ai_tpu.data import schema
+
+    store, X = serving_artifact
+    # cache off: both adapters compute every response through the batcher,
+    # so a hit-vs-miss difference can never masquerade as adapter parity
+    svc = ScorerService.from_store(
+        store, _cfg(microbatch_enabled=True, score_cache_size=0)
+    )
+    import pandas as pd
+
+    csv = (
+        pd.DataFrame(X[:3], columns=list(schema.SERVING_FEATURES))
+        .to_csv(index=False)
+        .encode()
+    )
+    ok = json.dumps(_valid_payload()).encode()
+    probes = [
+        ("/predict", ok, "application/json"),
+        ("/predict", b"{}", "application/json"),
+        ("/predict", b"{not json", "application/json"),
+        ("/predict_bulk_csv", csv, "text/csv"),
+        ("/feature_importance_bulk", b'{"data": [{"a": 1.0}]}',
+         "application/json"),
+        ("/feature_importance_bulk", b'{"data": []}', "application/json"),
+        ("/healthz", None, ""),
+        ("/nope", None, ""),
+        ("/nope", b"{}", "application/json"),
+    ]
+    try:
+        observed: dict[str, list] = {}
+        for impl in ("asyncio", "threaded"):
+            with _serving(impl, svc) as base:
+                observed[impl] = [
+                    _request(base + path, data, ct)[:2]
+                    for path, data, ct in probes
+                ]
+        for (path, _, _), a, t in zip(
+            probes, observed["asyncio"], observed["threaded"]
+        ):
+            assert a == t, f"{path}: asyncio {a} != threaded {t}"
+    finally:
+        svc.close()
+
+
+# --- observability contracts on the asyncio adapter ---------------------------
+
+
+def test_asyncio_adapter_observability_contracts(serving_artifact):
+    """/readyz, /slo, /debug/* and /metrics (classic + OpenMetrics) serve
+    their exact threaded-era contracts from the event loop."""
+    from cobalt_smart_lender_ai_tpu.telemetry import parse_exposition
+
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store, _cfg(microbatch_enabled=True))
+    ok = json.dumps(_valid_payload()).encode()
+    try:
+        with _serving("asyncio", svc) as base:
+            for _ in range(3):
+                status, _, _ = _request(base + "/predict", ok)
+                assert status == 200
+
+            status, body, _ = _request(base + "/readyz")
+            ready = json.loads(body)
+            assert status == 200 and ready["status"] == "ok"
+            assert {"model_key", "admission", "breaker"} <= set(ready)
+
+            status, body, _ = _request(base + "/slo")
+            slo = json.loads(body)
+            assert status == 200
+            assert {"fast_burn", "windows_s", "objectives"} <= set(slo)
+
+            status, body, _ = _request(base + "/debug/requests?limit=5")
+            recent = json.loads(body)["recent"]
+            assert recent and {"request_id", "trace_id", "phases_ms"} <= set(
+                recent[0]
+            )
+            status, body, _ = _request(base + "/debug/requests?limit=0")
+            assert status == 422
+            assert json.loads(body)["error"] == "invalid_input"
+            status, body, _ = _request(base + "/debug/requests?phase=nope")
+            assert status == 422
+            assert json.loads(body)["error"] == "invalid_input"
+
+            status, body, _ = _request(base + "/debug/slowest?limit=3")
+            assert status == 200 and "slowest" in json.loads(body)
+
+            status, body, _ = _request(base + "/debug/programs")
+            progs = json.loads(body)
+            assert status == 200
+            assert {"programs", "totals"} <= set(progs)
+
+            status, body, _ = _request(base + "/debug/trace")
+            assert status == 200 and "traceEvents" in json.loads(body)
+
+            status, body, headers = _request(base + "/metrics")
+            assert status == 200
+            assert headers["Content-Type"].startswith("text/plain")
+            families = parse_exposition(body.decode())
+            assert "cobalt_request_latency_seconds" in families
+            assert "cobalt_request_phase_seconds" in families
+
+            status, body, headers = _request(
+                base + "/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            )
+            text = body.decode()
+            assert status == 200
+            assert headers["Content-Type"].startswith(
+                "application/openmetrics-text"
+            )
+            assert text.rstrip().endswith("# EOF")
+            assert "# {trace_id=" in text  # exemplars on latency buckets
+    finally:
+        svc.close()
+
+
+def test_request_id_minted_at_ingress_joins_everything(serving_artifact):
+    """An id-less client gets a minted X-Request-ID whose value joins the
+    flight record, the batch span's ``request_ids`` (previously ``[]`` for
+    id-less clients), and — via the flight record's trace id — the
+    OpenMetrics exemplars. Error logs carry the same id."""
+    import logging
+
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(
+        store, _cfg(microbatch_enabled=True, score_cache_size=0)
+    )
+    ok = json.dumps(_valid_payload()).encode()
+    try:
+        with _serving("asyncio", svc) as base:
+            status, _, headers = _request(base + "/predict", ok)
+            assert status == 200
+            rid = headers["X-Request-ID"]
+            assert rid  # minted server-side, echoed back
+
+            # flight record join
+            _, body, _ = _request(base + "/debug/requests?limit=50")
+            recs = [
+                r
+                for r in json.loads(body)["recent"]
+                if r["request_id"] == rid
+            ]
+            assert recs, "minted id absent from the flight recorder"
+            trace_id = recs[0]["trace_id"]
+
+            # batch span join: the dispatch span names the minted id
+            _, body, _ = _request(base + "/debug/trace")
+            batch_spans = [
+                ev
+                for ev in json.loads(body)["traceEvents"]
+                if ev.get("name") == "serve.microbatch_dispatch"
+            ]
+            assert batch_spans, "no dispatch spans in the ring"
+            sped = [
+                ev
+                for ev in batch_spans
+                if rid in (ev.get("args") or {}).get("request_ids", [])
+            ]
+            assert sped, "minted id absent from batch span request_ids"
+            for ev in batch_spans:
+                assert (ev.get("args") or {}).get("request_ids"), (
+                    "empty request_ids on a dispatch span: ingress minting "
+                    "regressed"
+                )
+
+            # exemplar join via the flight record's trace id
+            _, body, _ = _request(
+                base + "/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            )
+            assert f'trace_id="{trace_id}"' in body.decode()
+
+            # log join: an error log inside the same request context carries
+            # the client-visible id
+            logger = logging.getLogger("cobalt.serve.http_asyncio")
+            seen: list[str] = []
+
+            class _Tap(logging.Handler):
+                def emit(self, record):
+                    seen.append(record.getMessage())
+
+            tap = _Tap()
+            logger.addHandler(tap)
+            try:
+                status, _, headers = _request(base + "/predict", b"{}")
+                assert status == 422
+                err_rid = headers["X-Request-ID"]
+                # the warning is emitted on the server's loop thread; give
+                # it a beat to land before inspecting
+                give_up = time.monotonic() + 5.0
+                while (
+                    not any(err_rid in line for line in seen)
+                    and time.monotonic() < give_up
+                ):
+                    time.sleep(0.01)
+            finally:
+                logger.removeHandler(tap)
+            assert any(err_rid in line for line in seen)
+    finally:
+        svc.close()
+
+
+# --- chaos soak ---------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_async_chaos_soak_zero_untyped_500s(tmp_path, serving_artifact):
+    """Store faults + injected latency + concurrent hot swaps against the
+    ASYNCIO adapter: every observed failure is a typed policy decision
+    (zero untyped 500s), scoring keeps working, and the loop serves
+    /metrics throughout."""
+    from cobalt_smart_lender_ai_tpu.data import schema
+
+    shared, X = serving_artifact
+    art = GBDTArtifact.load(shared, "models/gbdt/model_tree")
+    store = ObjectStore(str(tmp_path / "lake"))
+    art.save(store, "models/gbdt/model_tree")
+    import jax.numpy as jnp
+
+    zeroed = dataclasses.replace(
+        art,
+        forest=dataclasses.replace(
+            art.forest, leaf_value=jnp.zeros_like(art.forest.leaf_value)
+        ),
+    )
+    zeroed.save(store, "models/gbdt/v2")
+    store.put_bytes("models/poison.npz", b"\x00poisoned artifact bytes")
+    flaky = FaultInjectingStore(store, seed=7, faults={})
+    svc = ScorerService.from_store(
+        flaky,
+        _cfg(
+            microbatch_enabled=True,
+            request_deadline_s=10.0,
+            max_in_flight=4,
+            breaker_failure_threshold=3,
+            breaker_reset_s=0.2,
+        ),
+    )
+    flaky.faults["get"] = FaultSpec(rate=0.25, delay_s=0.002, delay_jitter_s=0.004)
+
+    import pandas as pd
+
+    ok = json.dumps(_valid_payload()).encode()
+    csv = (
+        pd.DataFrame(X[:8], columns=list(schema.SERVING_FEATURES))
+        .to_csv(index=False)
+        .encode()
+    )
+    cycle = [
+        ("/predict", ok, "application/json"),
+        ("/predict", b"{}", "application/json"),
+        ("/predict_bulk_csv", csv, "text/csv"),
+        ("/feature_importance_bulk", b'{"data": []}', "application/json"),
+        ("/metrics", None, ""),
+        ("/readyz", None, ""),
+    ]
+    results: list[tuple[str, int, bytes]] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def hammer(offset: int) -> None:
+        i = offset
+        while not stop.is_set():
+            path, data, ct = cycle[i % len(cycle)]
+            i += 1
+            try:
+                status, body, _ = _request(base + path, data, ct)
+            except urllib.error.URLError:
+                continue
+            with lock:
+                results.append((path, status, body))
+
+    try:
+        with _serving("asyncio", svc) as base:
+            threads = [
+                threading.Thread(target=hammer, args=(i,), daemon=True)
+                for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            reload_ok = rolled_back = 0
+            # Two good keys per poison attempt: with a strict poison/good
+            # alternation, every half-open breaker probe lands back on the
+            # always-failing poison key and the good key only ever sees
+            # circuit_open 503s (lock-step starvation).
+            keys = ["models/gbdt/v2", "models/poison", "models/gbdt/model_tree"]
+            give_up = time.monotonic() + 120.0
+            # Keep the chaos running a while even after both outcomes are
+            # observed, so the hammer threads accumulate real traffic.
+            min_soak = time.monotonic() + 8.0
+            i = 0
+            while (
+                reload_ok < 1
+                or rolled_back < 1
+                or time.monotonic() < min_soak
+            ) and time.monotonic() < give_up:
+                status, body, _ = _request(
+                    base + "/admin/reload",
+                    json.dumps({"model_key": keys[i % len(keys)]}).encode(),
+                )
+                i += 1
+                parsed = json.loads(body)
+                if status == 200 and parsed.get("status") == "ok":
+                    reload_ok += 1
+                elif status == 500 and parsed.get("error") == "reload_failed":
+                    rolled_back += 1
+                elif status == 503:
+                    time.sleep(0.25)
+                time.sleep(0.01)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            del flaky.faults["get"]
+            final_status, final_body, _ = _request(base + "/predict", ok)
+    finally:
+        svc.close()
+
+    assert reload_ok >= 1, "no hot swap succeeded during chaos"
+    assert rolled_back >= 1, "no poisoned swap rolled back during chaos"
+    assert final_status == 200
+    assert 0.0 <= json.loads(final_body)["prob_default"] <= 1.0
+    assert len(results) > 50, "soak produced too little traffic"
+    allowed = {200, 400, 413, 422, 429, 500, 503, 504}
+    for path, status, body in results:
+        assert status in allowed, (path, status, body)
+        if status == 500:
+            assert "error" in json.loads(body), (path, body)
+    statuses = {s for _, s, _ in results}
+    assert 200 in statuses

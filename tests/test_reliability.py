@@ -686,8 +686,11 @@ def test_fastapi_adapter_degraded_and_health(degraded_service, monkeypatch):
 
     app = create_app(service=degraded_service)
     # payload keyed by field names: _Model.model_dump has no aliasing, and
-    # validate_single_input accepts field names directly
-    resp = app.posts["/predict"](_Model(**_contract_payload()))
+    # validate_single_input accepts field names directly; scoring handlers
+    # are native coroutines since the asyncio serving core
+    import asyncio
+
+    resp = asyncio.run(app.posts["/predict"](_Model(**_contract_payload())))
     assert resp["degraded"] is True and resp["shap_values"] is None
     assert 0.0 <= resp["prob_default"] <= 1.0
     assert app.gets["/healthz"]() == {"status": "ok"}

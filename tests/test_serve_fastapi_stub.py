@@ -254,7 +254,9 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
             alias=schema.SERVING_FIELD_ALIASES["hardship_status_No_Hardship"]
         )
 
-    resp = predict(SingleStub(**_payload_by_field_name()))
+    # handlers are natively async (the event-loop request path); the stub
+    # harness drives each coroutine on its own loop
+    resp = asyncio.run(predict(SingleStub(**_payload_by_field_name())))
     assert 0.0 <= resp["prob_default"] <= 1.0
     assert len(resp["shap_values"]) == 20
 
@@ -275,10 +277,12 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
     class BulkStub(_BaseModel):
         pass
 
-    top = app.routes["/feature_importance_bulk"](BulkStub(data=[{"a": 1.0}]))
+    top = asyncio.run(
+        app.routes["/feature_importance_bulk"](BulkStub(data=[{"a": 1.0}]))
+    )
     assert top["top_features"]
     with pytest.raises(_HTTPException) as ei:
-        app.routes["/feature_importance_bulk"](BulkStub(data=[]))
+        asyncio.run(app.routes["/feature_importance_bulk"](BulkStub(data=[])))
     assert ei.value.status_code == 400
 
     # /admin/reload: hot swap of the currently-served key succeeds (the
@@ -291,7 +295,7 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
             except KeyError:
                 raise AttributeError(name)
 
-    result = app.routes["/admin/reload"](ReloadStub(model_key=None))
+    result = asyncio.run(app.routes["/admin/reload"](ReloadStub(model_key=None)))
     assert result["status"] == "ok"
 
 
@@ -314,5 +318,7 @@ def test_fastapi_lifespan_restores_from_store(fastapi_stubbed, serving_artifact)
     class BulkStub(_BaseModel):
         pass
 
-    resp = app.routes["/feature_importance_bulk"](BulkStub(data=[{"x": 1}]))
+    resp = asyncio.run(
+        app.routes["/feature_importance_bulk"](BulkStub(data=[{"x": 1}]))
+    )
     assert resp["top_features"]
