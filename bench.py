@@ -141,6 +141,12 @@ def main() -> None:
         help="write a run ledger (env, stage durations, program cost table) "
         "to this path; render with tools/obs_report.py",
     )
+    parser.add_argument(
+        "--trend-out",
+        default=None,
+        help="append this run's headline metrics to the given TREND.json "
+        "(gate with tools/perf_sentinel.py check)",
+    )
     args = parser.parse_args()
 
     from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
@@ -172,6 +178,13 @@ def main() -> None:
                 {k: out[k] for k in out if k != "telemetry"},
             )
             ledger.write(args.ledger_out)
+        if args.trend_out:
+            from cobalt_smart_lender_ai_tpu.telemetry.trend import append_record
+
+            append_record(
+                args.trend_out, out, source="bench.py --protocol",
+                stamp=time.time(),
+            )
         print(json.dumps(out))
         return
 
@@ -283,6 +296,10 @@ def main() -> None:
             "headline", {k: line[k] for k in line if k != "telemetry"}
         )
         ledger.write(args.ledger_out)
+    if args.trend_out:
+        from cobalt_smart_lender_ai_tpu.telemetry.trend import append_record
+
+        append_record(args.trend_out, line, source="bench.py", stamp=time.time())
     print(json.dumps(line))
 
 

@@ -231,22 +231,20 @@ def run_http_smoke(
     clients: int,
     duration_s: float,
 ) -> dict:
-    """Stand up the stdlib HTTP server on a loopback port, drive concurrent
+    """Stand up the asyncio HTTP server on a loopback port, drive concurrent
     POST /predict load over real sockets, and scrape ``GET /metrics`` both
     mid-load and after — validating the exposition parses and the
     request-latency histogram actually counted the traffic. This is the CI
     gate for the telemetry wiring (tier1.yml bench-smoke job)."""
     import http.client
 
-    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
     from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
     from cobalt_smart_lender_ai_tpu.telemetry import parse_exposition
 
     service = ScorerService(artifact, config)
-    httpd = make_server(service)
-    port = httpd.server_address[1]
-    server_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
-    server_thread.start()
+    server = make_async_server(service)
+    port = server.port
 
     errors = [0] * clients
     requests = [0] * clients
@@ -309,8 +307,7 @@ def run_http_smoke(
         slo_report = json.loads(scrape("/slo")[0])
         slowest = json.loads(scrape("/debug/slowest?k=3")[0])
     finally:
-        httpd.shutdown()
-        httpd.server_close()
+        server.close()
         service.close()
 
     latency = families.get("cobalt_request_latency_seconds", {"samples": {}})
@@ -376,24 +373,17 @@ async def _read_http_response(reader) -> tuple[int, bytes]:
 def _start_bench_server(impl: str, service) -> tuple[int, "object"]:
     """Stand up one adapter over ``service`` on a loopback port. Returns
     ``(port, shutdown_callable)``."""
-    if impl == "asyncio":
-        from cobalt_smart_lender_ai_tpu.serve.http_asyncio import (
-            make_async_server,
+    if impl != "asyncio":
+        raise SystemExit(
+            f"unknown serving impl {impl!r} (the threaded adapter was "
+            "removed; only 'asyncio' remains)"
         )
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import (
+        make_async_server,
+    )
 
-        server = make_async_server(service)
-        return server.port, server.close
-    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
-
-    httpd = make_server(service)
-    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
-    thread.start()
-
-    def _shutdown() -> None:
-        httpd.shutdown()
-        httpd.server_close()
-
-    return httpd.server_address[1], _shutdown
+    server = make_async_server(service)
+    return server.port, server.close
 
 
 def run_async_load(
@@ -445,9 +435,8 @@ def run_async_load(
                     await writer.drain()
                     status, body = await _read_http_response(reader)
                 except (ConnectionError, asyncio.IncompleteReadError):
-                    # The threaded adapter may drop keep-alive connections
-                    # under load; a clean close between requests is normal
-                    # HTTP/1.1, not a scoring error — reconnect and retry.
+                    # A clean close between requests is normal HTTP/1.1,
+                    # not a scoring error — reconnect and retry.
                     writer.close()
                     reader, writer = await asyncio.open_connection(
                         "127.0.0.1", port
@@ -627,12 +616,12 @@ def run_async_http_bench(
     warmup_s: float,
     mb_kwargs: dict,
 ) -> dict:
-    """The BENCH_SERVE_r03 protocol: the same trained artifact served by the
-    asyncio adapter and the threaded rollback adapter, each driven at every
-    requested client count over real sockets by `run_async_load`. The score
-    cache is OFF so every request exercises the full request path (the r02
-    in-process baseline predates the cache); the batcher is ON for both
-    impls — the comparison isolates the frontends."""
+    """The BENCH_SERVE_r03 protocol: the same trained artifact served by
+    each adapter in ``impls`` (asyncio, since the threaded rollback adapter
+    was removed), driven at every requested client count over real sockets
+    by `run_async_load`. The score cache is OFF so every request exercises
+    the full request path (the r02 in-process baseline predates the cache);
+    the batcher is ON — the protocol isolates the frontend."""
     import os
 
     from cobalt_smart_lender_ai_tpu.config import ReliabilityConfig, ServeConfig
@@ -718,16 +707,6 @@ def run_async_http_bench(
         else (os.cpu_count() or 1),
         "results": results,
     }
-    if "asyncio" in results and "threaded" in results:
-        record["qps_speedup_asyncio_vs_threaded"] = {
-            key: round(
-                results["asyncio"][key]["qps"] / results["threaded"][key]["qps"],
-                2,
-            )
-            for key in results["asyncio"]
-            if key in results["threaded"]
-            and results["threaded"][key]["qps"] > 0
-        }
     return record
 
 
@@ -855,9 +834,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--client-counts", default="128,256,512",
                         help="comma-separated client counts for "
                         "--async-clients")
-    parser.add_argument("--impls", default="asyncio,threaded",
+    parser.add_argument("--impls", default="asyncio",
                         help="comma-separated adapters for --async-clients "
-                        "(asyncio and/or threaded)")
+                        "(only 'asyncio' remains)")
     parser.add_argument("--http-smoke", action="store_true",
                         help="also drive load over real HTTP and scrape "
                         "/metrics during it (validates the telemetry wiring; "
@@ -872,6 +851,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="write a run ledger (env, headline, program "
                         "cost table) to this path; render with "
                         "tools/obs_report.py")
+    parser.add_argument("--trend-out", default=None,
+                        help="append this run's headline metrics to the "
+                        "given TREND.json (gate with tools/perf_sentinel.py "
+                        "check)")
     args = parser.parse_args(argv)
     if args.force_devices:
         import os
@@ -890,6 +873,15 @@ def main(argv: list[str] | None = None) -> int:
         args.rows = min(args.rows, 800)
         args.bulk_rows = min(args.bulk_rows, 16384)
         args.bulk_repeats = min(args.bulk_repeats, 2)
+
+    def _write_trend(record: dict) -> None:
+        if not args.trend_out:
+            return
+        from cobalt_smart_lender_ai_tpu.telemetry.trend import append_record
+
+        append_record(
+            args.trend_out, record, source="bench_serve.py", stamp=time.time()
+        )
 
     def _write_ledger(record: dict) -> None:
         if not args.ledger_out:
@@ -949,6 +941,7 @@ def main(argv: list[str] | None = None) -> int:
             with open(args.out, "w") as fh:
                 fh.write(line + "\n")
         _write_ledger(record)
+        _write_trend(record)
         return 0
 
     from cobalt_smart_lender_ai_tpu.config import ServeConfig
@@ -988,6 +981,7 @@ def main(argv: list[str] | None = None) -> int:
             with open(args.out, "w") as fh:
                 fh.write(line + "\n")
         _write_ledger(record)
+        _write_trend(record)
         return 0
 
     modes = {"both": ("off", "on"), "on": ("on",), "off": ("off",)}[args.mode]
@@ -1084,6 +1078,7 @@ def main(argv: list[str] | None = None) -> int:
         with open(args.out, "w") as fh:
             fh.write(line + "\n")
     _write_ledger(record)
+    _write_trend(record)
     if args.trace_out:
         from cobalt_smart_lender_ai_tpu.telemetry import (
             default_tracer,

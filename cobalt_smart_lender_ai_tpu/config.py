@@ -347,6 +347,20 @@ class ServeConfig:
     flight_capacity: int = 256
     flight_slow_threshold_ms: float = 100.0
     flight_top_k: int = 32
+    #: Telemetry history (telemetry.timeseries, served at ``GET /history``
+    #: and ``GET /dashboard``): a background sampler scrapes the service
+    #: registry every ``history_interval_s`` into tiered downsampled rings
+    #: of (bucket width s, capacity) — counter rates, per-window histogram
+    #: quantiles, gauges — all bounded memory. The sampler thread starts
+    #: with the HTTP server (never in bare `ScorerService` construction),
+    #: so in-process uses pay nothing unless they opt in.
+    history_enabled: bool = True
+    history_interval_s: float = 10.0
+    history_tiers: tuple[tuple[float, int], ...] = (
+        (10.0, 360),
+        (60.0, 720),
+        (600.0, 1008),
+    )
     #: SLO engine (telemetry.slo, served at ``GET /slo`` and as
     #: ``cobalt_slo_*`` gauges). Latency thresholds are snapped down to the
     #: nearest histogram bucket bound at evaluation (reported per

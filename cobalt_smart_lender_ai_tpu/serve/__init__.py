@@ -17,10 +17,10 @@
   loop from socket accept to batcher future. Request coroutines suspend on
   ``MicroBatcher.submit_async`` / deadline awaits instead of parking OS
   threads, so hundreds of in-flight requests cost one thread total.
-- `http_stdlib` — the legacy thread-per-connection http.server adapter.
-  Deprecated; kept for one release as the rollback path
-  (``--serve-impl threaded``) with a byte-parity test against the asyncio
-  adapter.
+- `http_stdlib` — shared route helpers (`_KNOWN_ROUTES`, the debug and
+  /history query validators, payload builders) both adapters import so the
+  contract cannot drift. The thread-per-connection adapter that used to
+  live here was removed after its one-release deprecation window.
 - `http_fastapi` — FastAPI adapter with the exact pydantic `SingleInput`
   contract, for deployments that have fastapi installed; scoring endpoints
   are native ``async def`` (no threadpool offload).

@@ -8,11 +8,12 @@ contract — ``python -m cobalt_smart_lender_ai_tpu.serve --store artifacts``.
 - ``asyncio``: the event-loop server (`serve.http_asyncio`) — one loop from
   socket accept to batcher future; request coroutines suspend on awaits
   instead of parking OS threads.
-- ``threaded``: the legacy thread-per-connection adapter
-  (`serve.http_stdlib`). Deprecated — kept for one release as the rollback
-  path while the asyncio core beds in; a parity test pins both adapters to
-  byte-identical bodies.
 - ``fastapi``: force the FastAPI adapter (errors if fastapi is missing).
+
+The deprecated ``threaded`` thread-per-connection adapter completed its
+scheduled one-release rollback window and was removed; ``asyncio`` is the
+zero-dependency frontend (`serve.http_stdlib` survives as the shared route
+helpers both remaining adapters import).
 """
 
 from __future__ import annotations
@@ -117,11 +118,10 @@ def main() -> None:
     )
     parser.add_argument(
         "--serve-impl",
-        choices=("auto", "asyncio", "threaded", "fastapi"),
+        choices=("auto", "asyncio", "fastapi"),
         default="auto",
         help="HTTP frontend: auto (fastapi if installed, else asyncio), "
-        "asyncio (event-loop server), threaded (deprecated rollback "
-        "adapter, removed next release), fastapi (require fastapi)",
+        "asyncio (event-loop server), fastapi (require fastapi)",
     )
     parser.add_argument(
         "--profile-dir",
@@ -206,16 +206,6 @@ def main() -> None:
                     raise SystemExit(
                         "--serve-impl fastapi requires fastapi+uvicorn"
                     )
-        if impl == "threaded":
-            from cobalt_smart_lender_ai_tpu.serve.http_stdlib import (
-                serve_forever,
-            )
-
-            print("[WARN] --serve-impl threaded is deprecated; it is the "
-                  "rollback path for this release only")
-            print(f"[INFO] serving (stdlib threaded) on {cfg.host}:{cfg.port}")
-            serve_forever(service, cfg.host, cfg.port)
-            return
         from cobalt_smart_lender_ai_tpu.serve.http_asyncio import (
             serve_forever as serve_forever_async,
         )

@@ -1,7 +1,7 @@
 """Asyncio-native HTTP adapter: one event loop from socket to batcher future.
 
 The default frontend (README "Performance" / "Serving guarantees"). The
-threaded stdlib adapter (`http_stdlib.py`) burns an OS thread — and its
+removed thread-per-connection stdlib adapter burned an OS thread — and its
 context switches, lock handoffs, and GIL contention — per in-flight request;
 at 128+ closed-loop clients that thread army IS the latency. Here one
 `asyncio.start_server` event loop owns the whole request path: accept, parse,
@@ -12,13 +12,13 @@ the batcher's worker thread (the single consumer that must block on the
 device dispatch anyway) wakes it on resolve. BENCH_SERVE_r03.json measures
 the difference at 128/256/512 clients.
 
-Contract parity with `http_stdlib.py` is deliberate and byte-level: the same
+Contract parity with the FastAPI adapter is deliberate: the same
 `_KNOWN_ROUTES` surface, the same typed error taxonomy
 (`reliability.errors`; 422/413/429/503/504 + the admin 409s), the same JSON
-encoder — a parity test asserts both adapters return byte-identical bodies
-for the same scoring request. The shared route helpers
-(`validate_debug_limit`, `validate_debug_phase`, `debug_programs_payload`,
-`_extract_csv`) are imported from the stdlib adapter, not re-implemented.
+encoder. The shared route helpers (`validate_debug_limit`,
+`validate_debug_phase`, `debug_programs_payload`, `history_payload`,
+`dashboard_html`, `_extract_csv`) are imported from `http_stdlib` — now a
+helpers-only module — not re-implemented, so the contract cannot drift.
 
 Hardening composes unchanged in async form:
 
@@ -64,7 +64,9 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
 from cobalt_smart_lender_ai_tpu.serve.http_stdlib import (
     _KNOWN_ROUTES,
     _extract_csv,
+    dashboard_html,
     debug_programs_payload,
+    history_payload,
     validate_debug_limit,
     validate_debug_phase,
 )
@@ -168,7 +170,7 @@ class AsyncScorerServer:
     facade). Two run modes: `serve_forever` (module function) blocks the
     calling thread on its own ``asyncio.run`` for the CLI, while
     `start()` / `close()` run the loop on a background thread so tests and
-    bench harnesses drive it like the threaded `make_server`."""
+    bench harnesses can drive it synchronously."""
 
     def __init__(
         self, service: ScorerService, host: str = "127.0.0.1", port: int = 0
@@ -191,6 +193,11 @@ class AsyncScorerServer:
             self._serve_connection, self._host, self._port
         )
         self._bound_port = self._server.sockets[0].getsockname()[1]
+        # History sampling is a serving concern: the tiered rings behind
+        # GET /history and /dashboard start filling when the socket opens.
+        start_history = getattr(self.service, "start_history", None)
+        if start_history is not None:
+            start_history()
         return self
 
     def start(self) -> "AsyncScorerServer":
@@ -623,6 +630,48 @@ class AsyncScorerServer:
                 render_chrome_trace(default_tracer()).encode(),
                 TRACE_CONTENT_TYPE,
             )
+        elif path == "/history":
+            history = getattr(service, "history", None)
+            if history is None:
+                await self._send(
+                    st,
+                    404,
+                    {
+                        "detail": "history disabled",
+                        "error": "history_disabled",
+                    },
+                )
+            else:
+                await self._send(
+                    st,
+                    200,
+                    history_payload(
+                        history,
+                        st.query.get("series", [None])[-1],
+                        st.query.get("window", [None])[-1],
+                        st.query.get("step", [None])[-1],
+                    ),
+                )
+        elif path == "/dashboard":
+            history = getattr(service, "history", None)
+            if history is None:
+                await self._send(
+                    st,
+                    404,
+                    {
+                        "detail": "history disabled",
+                        "error": "history_disabled",
+                    },
+                )
+            else:
+                await self._send_bytes(
+                    st,
+                    200,
+                    dashboard_html(
+                        history, window=st.query.get("window", [None])[-1]
+                    ).encode(),
+                    "text/html; charset=utf-8",
+                )
         else:
             await self._send(st, 404, {"detail": "Not Found"})
 
@@ -631,16 +680,15 @@ def make_async_server(
     service: ScorerService, host: str = "127.0.0.1", port: int = 0
 ) -> AsyncScorerServer:
     """Build-and-start the background-thread server; port 0 picks a free
-    port — the async mirror of `http_stdlib.make_server` for in-process
-    tests and bench harnesses. Callers own ``.close()`` (and the service)."""
+    port — the one-call bind for in-process tests and bench harnesses.
+    Callers own ``.close()`` (and the service)."""
     return AsyncScorerServer(service, host, port).start()
 
 
 def serve_forever(
     service: ScorerService, host: str = "0.0.0.0", port: int = 8000
 ) -> None:
-    """Blocking server loop — the asyncio replacement for the threaded
-    adapter's `serve_forever` (same contract: drains the service at exit)."""
+    """Blocking server loop for the CLI (drains the service at exit)."""
 
     async def _main() -> None:
         server = await AsyncScorerServer(service, host, port).start_async()

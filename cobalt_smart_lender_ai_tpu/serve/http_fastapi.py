@@ -1,10 +1,10 @@
 """FastAPI adapter over `ScorerService` — route/schema parity with the
 reference's `cobalt_fast_api.py`, importable only where fastapi is installed
-(it is not in this offline image; the stdlib adapter covers that case).
+(it is not in this offline image; the asyncio adapter covers that case).
 
 The pydantic schema reproduces `SingleInput` (cobalt_fast_api.py:59-82)
 including the two aliased field names with spaces and
-population-by-field-name. Error mapping is shared with the stdlib adapter
+population-by-field-name. Error mapping is shared with the asyncio adapter
 through `reliability.errors.error_response`, so both adapters emit the same
 taxonomy (422/413/429/503/504 with ``Retry-After`` where applicable), and
 both expose the same admin plane (``POST /admin/reload`` hot swap,
@@ -20,7 +20,7 @@ FastAPI's default sync-handler-in-a-threadpool model. Blocking admin work
 (hot reload = restore + compile) runs on the default executor so the data
 plane keeps serving during a swap.
 
-Telemetry (mirrored in `http_stdlib.py`): each route body runs inside
+Telemetry (mirrored in `http_asyncio.py`): each route body runs inside
 `_track(route, ...)` — a per-request envelope that binds the request-id
 context (honoring the client's ``X-Request-ID``, echoing the id on the
 response), records wall time into
@@ -134,6 +134,12 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
         if owns_service:
             uri = store_uri or "artifacts"  # store ROOT; model_key is appended
             state["service"] = ScorerService.from_store(ObjectStore(uri))
+        # History sampling is a serving concern — the tiered rings behind
+        # GET /history and /dashboard start filling when the app comes up
+        # (same moment the asyncio adapter's socket-open hook fires).
+        start_history = getattr(state["service"], "start_history", None)
+        if start_history is not None:
+            start_history()
         yield
         if owns_service:
             # shutdown: drain the micro-batch scheduler (a service passed in
@@ -157,7 +163,8 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
         """Per-request telemetry envelope (see module docstring). `request`
         and `response` are None under the stub harness, which calls the
         handlers directly — the envelope still times, counts, flight-records
-        and logs. Mirrors `http_stdlib._handle`: the root ``http.request``
+        and logs. Mirrors the asyncio adapter's middleware: the root
+        ``http.request``
         span's id is the request's trace id (log lines, flight record,
         ``GET /debug/trace``, latency-histogram exemplar all join on it)."""
         rid_header = None
@@ -418,5 +425,40 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
             content=json.dumps(chrome_trace(default_tracer())),
             media_type=TRACE_CONTENT_TYPE,
         )
+
+    def _history_or_404(on_disabled: str):
+        history = getattr(state["service"], "history", None)
+        if history is None:
+            exc = HTTPException(status_code=404, detail=on_disabled)
+            exc.cobalt_code = "history_disabled"
+            raise exc
+        return history
+
+    @app.get("/history")
+    def history(series: str = None, window: str = None, step: str = None):
+        from cobalt_smart_lender_ai_tpu.serve.http_stdlib import (
+            history_payload,
+        )
+
+        hist = _history_or_404("history disabled")
+        try:
+            return history_payload(hist, series, window, step)
+        except RequestError as e:  # malformed params / unknown series -> 422
+            _raise_typed(e)
+
+    @app.get("/dashboard")
+    def dashboard(window: str = None):
+        from cobalt_smart_lender_ai_tpu.serve.http_stdlib import (
+            dashboard_html,
+        )
+
+        hist = _history_or_404("history disabled")
+        try:
+            return Response(
+                content=dashboard_html(hist, window=window),
+                media_type="text/html; charset=utf-8",
+            )
+        except RequestError as e:
+            _raise_typed(e)
 
     return app

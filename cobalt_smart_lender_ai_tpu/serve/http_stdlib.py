@@ -1,101 +1,41 @@
-"""Zero-dependency HTTP adapter over `ScorerService` (stdlib http.server).
+"""Shared HTTP route helpers for the serving adapters.
 
-DEPRECATED — rollback path only. The asyncio event-loop adapter
-(`serve.http_asyncio`) replaced this thread-per-connection server as the
-default zero-dependency frontend; select this one with
-``--serve-impl threaded`` if the asyncio core misbehaves in your
-deployment. It is kept for exactly one release — a parity test
-(tests/test_async_serve.py) pins both adapters to byte-identical response
-bodies until removal. The shared route helpers defined here
-(`_KNOWN_ROUTES`, `validate_debug_limit`, `validate_debug_phase`,
-`debug_programs_payload`, `_extract_csv`) are imported by the asyncio
-adapter and will move there when this module is dropped.
+This module used to be the thread-per-connection ``http.server`` adapter
+(``--serve-impl threaded``). The asyncio event-loop adapter
+(`serve.http_asyncio`) replaced it as the default zero-dependency
+frontend in PR 13, the deprecation ran its scheduled one release, and
+the handler/server half is now gone — `serve.__main__` accepts only
+``auto`` / ``asyncio`` / ``fastapi``, and every in-process harness binds
+through `http_asyncio.make_async_server`.
 
-This environment has no fastapi/uvicorn; the serving contract still has to be
-reachable over real HTTP (the reference serves on port 8000,
-`cobalt_fast_api.py:148-149`). Routes, methods, status codes and JSON bodies
-match the reference:
+What remains is the adapter-shared contract surface both frontends
+import so the route taxonomy can never drift between them:
 
-- ``POST /predict``                — JSON body, 422 on schema violation;
-  concurrent requests are coalesced into one device dispatch by the
-  service's micro-batcher (the ThreadingHTTPServer's per-request threads
-  are exactly the concurrency it amortizes)
-- ``POST /predict_bulk_csv``      — multipart file upload or raw CSV body
-- ``POST /feature_importance_bulk`` — JSON ``{"data": [...]}``, 400 if empty
-- ``POST /admin/reload``          — hot model swap (optional ``model_key``)
-- ``POST /admin/promote``         — canary promotion gate + atomic swap
-  (409 ``promotion_rejected`` with the gate report when the canary fails;
-  ``{"force": true}`` bypasses the gate)
-- ``POST /admin/rollback``        — demote ``latest`` back to ``previous``
-  (409 ``rollback_failed`` when there is nothing to restore)
-- ``GET /drift``                  — per-feature PSI of live traffic vs the
-  training snapshot (serve.canary / telemetry.drift)
-- ``GET /metrics``                — Prometheus text exposition of
-  ``service.registry`` (README "Observability"); with ``Accept:
-  application/openmetrics-text`` the latency buckets carry exemplar
-  trace ids
-- ``GET /slo``                    — SLO burn-rate report (telemetry.slo)
-- ``GET /debug/requests``         — recent flight records (``?limit=``,
-  ``?phase=`` to keep only records that spent time in one serving phase;
-  legacy ``?n=`` still accepted)
-- ``GET /debug/slowest``          — top-K requests by wall time
-  (``?limit=``/``?k=``, ``?phase=``)
-- ``GET /debug/trace``            — span ring as Chrome-trace/Perfetto JSON
-  (plus sampled counter tracks)
-- ``GET /debug/programs``         — the process program cost table
-  (telemetry.programs): per compiled program, compile wall, cost_analysis
-  estimates, dispatch count/seconds, achieved FLOP/s
-
-Errors return ``{"detail": ...}`` like FastAPI's HTTPException, plus a stable
-machine-readable ``"error"`` code from `reliability.errors` — the taxonomy
-both adapters map identically (422/413/429/503/504; see README "Serving
-guarantees"). Scoring routes are gated by `service.admission` (shed → 429
-with ``Retry-After``) and honor the per-request deadline (504). The handler
-is threaded (one TPU dispatch at a time is serialized by JAX itself, so a
-ThreadingHTTPServer is safe).
-
-Telemetry middleware (mirrored in `http_fastapi.py`): every request runs
-inside a `request_context` — the client's ``X-Request-ID`` is honored,
-otherwise one is minted, and either way the id is echoed on the response —
-its wall time lands in the ``cobalt_request_latency_seconds{route,status}``
-histogram (route is the matched template, never the raw path, so label
-cardinality stays bounded), and every non-2xx emits one structured JSON log
-line carrying the request id, route and typed error code.
+- `_KNOWN_ROUTES` — the routes that become metric label values
+  (anything else folds into ``unmatched``);
+- `validate_debug_limit` / `validate_debug_phase` — the typed-422
+  bounds of the ``GET /debug/*`` query params;
+- `validate_history_params` / `history_payload` — the typed-422 bounds
+  and body of ``GET /history`` (telemetry.timeseries);
+- `dashboard_html` — the ``GET /dashboard`` page body;
+- `debug_programs_payload` — the ``GET /debug/programs`` body;
+- `_extract_csv` — multipart/raw CSV extraction for the bulk route.
 """
 
 from __future__ import annotations
 
 import email.parser
 import email.policy
-import json
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, urlsplit
+import math
+from typing import Any
 
-from cobalt_smart_lender_ai_tpu.reliability.errors import (
-    RequestError,
-    ValidationError,
-    error_response,
-)
-from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
-from cobalt_smart_lender_ai_tpu.telemetry import (
-    EXPOSITION_CONTENT_TYPE,
-    META_ROUTES,
-    OPENMETRICS_CONTENT_TYPE,
-    TRACE_CONTENT_TYPE,
-    collect_phases,
-    default_program_registry,
-    default_tracer,
-    get_logger,
-    render_chrome_trace,
-    request_context,
-)
+from cobalt_smart_lender_ai_tpu.reliability.errors import ValidationError
+from cobalt_smart_lender_ai_tpu.telemetry import default_program_registry
 from cobalt_smart_lender_ai_tpu.telemetry.flight import PHASES
 
 #: Hard ceiling for ``?limit=`` on the debug routes — forensics must never
 #: turn into an unbounded dump (both adapters validate against this).
 DEBUG_LIMIT_MAX = 1000
-
-_LOG = get_logger("cobalt.serve.http")
 
 #: Routes that become metric label values. Anything else is folded into
 #: "unmatched" — a path-scanning client must not mint one label per probe.
@@ -116,6 +56,8 @@ _KNOWN_ROUTES = frozenset(
         "/debug/slowest",
         "/debug/trace",
         "/debug/programs",
+        "/history",
+        "/dashboard",
     }
 )
 
@@ -138,6 +80,66 @@ def validate_debug_phase(phase: str | None) -> str | None:
             f"query param 'phase' must be one of {sorted(PHASES)}"
         )
     return phase
+
+
+def validate_history_params(
+    window: str | None, step: str | None
+) -> tuple[float | None, float | None]:
+    """Shared ``GET /history`` query validation: ``window`` and ``step``
+    are optional positive finite seconds; anything else is the same
+    typed 422 both adapters emit."""
+
+    def _positive(raw: str | None, name: str) -> float | None:
+        if raw is None:
+            return None
+        try:
+            value = float(raw)
+        except ValueError:
+            raise ValidationError(
+                f"query param {name!r} must be a number of seconds"
+            )
+        if not math.isfinite(value) or value <= 0:
+            raise ValidationError(
+                f"query param {name!r} must be a positive number of seconds"
+            )
+        return value
+
+    return _positive(window, "window"), _positive(step, "step")
+
+
+def history_payload(
+    history: Any,
+    series: str | None,
+    window: str | None,
+    step: str | None,
+) -> dict:
+    """``GET /history`` body, shared by both adapters. Without
+    ``series`` it returns the catalog (every derived series name plus
+    the tier layout); with one it returns that series' points from the
+    tier `TimeSeriesStore.query` selects. Unknown series and malformed
+    ``window``/``step`` raise the typed 422."""
+    window_s, step_s = validate_history_params(window, step)
+    if not series:
+        return {"series": history.series_names(), "tiers": history.tiers()}
+    try:
+        return history.query(series, window_s=window_s, step_s=step_s)
+    except KeyError:
+        raise ValidationError(
+            f"unknown series {series!r}; GET /history without params "
+            "lists every available series"
+        )
+
+
+def dashboard_html(history: Any, *, window: str | None = None) -> str:
+    """``GET /dashboard`` body: the stdlib-HTML sparkline page over the
+    service's history store (``?window=`` narrows it, same validation
+    as /history)."""
+    from cobalt_smart_lender_ai_tpu.telemetry.timeseries import (
+        render_dashboard,
+    )
+
+    window_s, _ = validate_history_params(window, None)
+    return render_dashboard(history, window_s=window_s)
 
 
 def debug_programs_payload() -> dict:
@@ -164,324 +166,3 @@ def _extract_csv(body: bytes, content_type: str) -> bytes:
                 return part.get_payload(decode=True)
         raise ValidationError("multipart body contains no file part")
     return body
-
-
-def make_handler(service: ScorerService):
-    class Handler(BaseHTTPRequestHandler):
-        # quieter default logging; the reference prints [INFO] lines instead
-        def log_message(self, fmt, *args):  # noqa: D102
-            pass
-
-        # -- response plumbing (status/code captured for the middleware) ----
-
-        def _send_bytes(
-            self, code: int, data: bytes, content_type: str,
-            headers: dict | None = None,
-        ) -> None:
-            self._status = code
-            self.send_response(code)
-            self.send_header("Content-Type", content_type)
-            self.send_header("Content-Length", str(len(data)))
-            if self._request_id:
-                self.send_header("X-Request-ID", self._request_id)
-            for name, value in (headers or {}).items():
-                self.send_header(name, value)
-            self.end_headers()
-            self.wfile.write(data)
-
-        def _send(self, code: int, obj, headers: dict | None = None) -> None:
-            if code >= 400 and isinstance(obj, dict):
-                self._error_code = obj.get("error")
-            if getattr(self, "_route_path", None) in META_ROUTES:
-                self._send_bytes(
-                    code, json.dumps(obj).encode(), "application/json", headers
-                )
-                return
-            # data-plane responses: encoding + socket write is the
-            # "serialize" phase of the flight record's breakdown
-            with service.phase("serialize"):
-                self._send_bytes(
-                    code, json.dumps(obj).encode(), "application/json", headers
-                )
-
-        def _json_body(self, body: bytes):
-            try:
-                return json.loads(body.decode() or "{}")
-            except (UnicodeDecodeError, json.JSONDecodeError):
-                raise ValidationError("body is not valid JSON")
-
-        # -- telemetry middleware ------------------------------------------
-
-        def _handle(self, method: str) -> None:
-            """Per-request envelope shared by GET and POST: request-id
-            context, a root ``http.request`` span (whose id is the
-            request's trace id — stamped on log lines, carried by the
-            flight record, resolvable at ``GET /debug/trace``, attached to
-            the latency histogram as an OpenMetrics exemplar), typed-error
-            mapping, latency observation, flight recording, structured
-            error log."""
-            split = urlsplit(self.path)
-            self._route_path = split.path
-            self._query = parse_qs(split.query)
-            route = (
-                self._route_path
-                if self._route_path in _KNOWN_ROUTES
-                else "unmatched"
-            )
-            self._status: int | None = None
-            self._error_code: str | None = None
-            self._request_id: str | None = None
-            with request_context(
-                self.headers.get("X-Request-ID") or None
-            ) as rid:
-                self._request_id = rid
-                with collect_phases() as phases, default_tracer().span(
-                    "http.request", route=route, method=method, request_id=rid
-                ) as root:
-                    try:
-                        if method == "POST":
-                            self._post()
-                        else:
-                            self._get()
-                    except RequestError as e:
-                        self._send(*error_response(e))
-                    except Exception as e:  # pragma: no cover
-                        self._send(
-                            500,
-                            {
-                                "detail": f"Internal server error: {e}",
-                                "error": "internal",
-                            },
-                        )
-                duration_s = root.duration_s or 0.0
-                status = self._status if self._status is not None else 500
-                service.observe_request(
-                    route,
-                    status,
-                    duration_s,
-                    code=self._error_code,
-                    trace_id=root.trace_id,
-                )
-                if route not in META_ROUTES:
-                    # the observability plane is not flight-recorded: a
-                    # scraper must not evict the data-plane records
-                    service.flight.record(
-                        request_id=rid,
-                        trace_id=root.trace_id,
-                        route=route,
-                        method=method,
-                        status=status,
-                        duration_s=duration_s,
-                        code=self._error_code,
-                        phases=phases.phases,
-                    )
-                if status >= 400:
-                    # the root span is closed here; stamp its ids explicitly
-                    _LOG.warning(
-                        "request_error",
-                        method=method,
-                        route=route,
-                        status=status,
-                        code=self._error_code or "error",
-                        duration_ms=round(duration_s * 1000.0, 3),
-                        trace_id=root.trace_id,
-                        span_id=root.span_id,
-                    )
-
-        def do_POST(self):  # noqa: N802 - http.server API
-            self._handle("POST")
-
-        def do_GET(self):  # noqa: N802
-            self._handle("GET")
-
-        # -- routes --------------------------------------------------------
-
-        def _post(self) -> None:
-            length = int(self.headers.get("Content-Length", 0))
-            body = self.rfile.read(length)
-            if self._route_path == "/admin/reload":
-                # Admin plane: never gated by scoring admission — an
-                # operator must be able to swap in a fixed model while the
-                # data plane is shedding.
-                self._admin_reload(body)
-                return
-            if self._route_path == "/admin/promote":
-                # Same admin plane; `PromotionRejected` (409 + structured
-                # gate report) propagates through the typed-error mapping.
-                payload = self._json_body(body)
-                force = isinstance(payload, dict) and bool(
-                    payload.get("force", False)
-                )
-                self._send(200, service.promote_canary(force=force))
-                return
-            if self._route_path == "/admin/rollback":
-                payload = self._json_body(body)
-                reason = (
-                    str(payload.get("reason", "manual"))
-                    if isinstance(payload, dict)
-                    else "manual"
-                )
-                self._send(200, service.rollback_model(reason=reason))
-                return
-            if self._route_path == "/predict":
-                with service.admission.admit():
-                    self._send(
-                        200, service.predict_single(self._json_body(body))
-                    )
-            elif self._route_path == "/predict_bulk_csv":
-                with service.admission.admit():
-                    try:
-                        csv_bytes = _extract_csv(
-                            body, self.headers.get("Content-Type", "")
-                        )
-                        self._send(200, service.predict_bulk_csv(csv_bytes))
-                    except RequestError:
-                        raise  # typed errors keep their status (422/413/504)
-                    except Exception as e:
-                        # parity with the reference's try/except -> HTTP 500
-                        # on the bulk route (cobalt_fast_api.py:124-126)
-                        self._send(
-                            500,
-                            {
-                                "detail": f"Bulk prediction failed: {e}",
-                                "error": "bulk_failed",
-                            },
-                        )
-            elif self._route_path == "/feature_importance_bulk":
-                with service.admission.admit():
-                    payload = self._json_body(body)  # malformed JSON -> 422
-                    try:
-                        self._send(
-                            200, service.feature_importance_bulk(payload)
-                        )
-                    except ValidationError as e:
-                        # this route 400s on empty data in the reference
-                        # (cobalt_fast_api.py:131), not 422
-                        self._send(400, e.body())
-            else:
-                self._send(404, {"detail": "Not Found"})
-
-        def _admin_reload(self, body: bytes) -> None:
-            payload = self._json_body(body)
-            if not isinstance(payload, dict):
-                raise ValidationError("body must be a JSON object")
-            result = service.reload_from_store(
-                model_key=payload.get("model_key")
-            )
-            if result["status"] == "ok":
-                self._send(200, result)
-            else:
-                self._send(
-                    500,
-                    {
-                        "detail": f"reload rolled back: {result['error']}",
-                        "error": "reload_failed",
-                        "status": result["status"],
-                        "model_key": result["model_key"],
-                    },
-                )
-
-        def _query_int(self, name: str, default: int) -> int:
-            raw = self._query.get(name, [None])[-1]
-            if raw is None:
-                return default
-            try:
-                return int(raw)
-            except ValueError:
-                raise ValidationError(f"query param {name!r} must be an integer")
-
-        def _query_limit(self, legacy: str, default: int) -> int:
-            """``?limit=`` (``?n=``/``?k=`` still accepted), bounded."""
-            name = "limit" if "limit" in self._query else legacy
-            value = self._query_int(name, default)
-            return validate_debug_limit(value, name)
-
-        def _query_phase(self) -> str | None:
-            return validate_debug_phase(
-                self._query.get("phase", [None])[-1]
-            )
-
-        def _get(self) -> None:
-            path = self._route_path
-            if path == "/healthz":
-                self._send(200, service.health())
-            elif path == "/readyz":
-                ready, payload = service.ready()
-                # degraded-but-scorable is still 200: readiness gates traffic
-                # on the probability contract, not the SHAP enrichment
-                self._send(200 if ready else 503, payload)
-            elif path == "/metrics":
-                # content negotiation: the OpenMetrics variant carries
-                # exemplar trace ids on latency buckets; the classic 0.0.4
-                # format (the default, what CI's strict parser pins) does not
-                accept = self.headers.get("Accept", "")
-                openmetrics = "application/openmetrics-text" in accept
-                self._send_bytes(
-                    200,
-                    service.registry.render(openmetrics=openmetrics).encode(),
-                    OPENMETRICS_CONTENT_TYPE
-                    if openmetrics
-                    else EXPOSITION_CONTENT_TYPE,
-                )
-            elif path == "/slo":
-                if service.slo is None:
-                    self._send(
-                        404, {"detail": "SLO engine disabled", "error": "slo_disabled"}
-                    )
-                else:
-                    self._send(200, service.slo.evaluate(force=True))
-            elif path == "/drift":
-                self._send(200, service.drift_report())
-            elif path == "/debug/requests":
-                n = self._query_limit("n", 50)
-                phase = self._query_phase()
-                self._send(
-                    200,
-                    {
-                        "recent": service.flight.records(n, phase),
-                        "errors": service.flight.errors(n, phase),
-                        "stats": service.flight.stats(),
-                    },
-                )
-            elif path == "/debug/slowest":
-                k = self._query_limit("k", service.flight.top_k)
-                phase = self._query_phase()
-                self._send(
-                    200,
-                    {
-                        "slowest": service.flight.slowest(k, phase),
-                        "stats": service.flight.stats(),
-                    },
-                )
-            elif path == "/debug/programs":
-                self._send(200, debug_programs_payload())
-            elif path == "/debug/trace":
-                self._send_bytes(
-                    200,
-                    render_chrome_trace(default_tracer()).encode(),
-                    TRACE_CONTENT_TYPE,
-                )
-            else:
-                self._send(404, {"detail": "Not Found"})
-
-    return Handler
-
-
-def serve_forever(service: ScorerService, host: str = "0.0.0.0", port: int = 8000):
-    """Blocking server loop — `uvicorn.run` stand-in (cobalt_fast_api.py:148)."""
-    httpd = make_server(service, host, port)
-    try:
-        httpd.serve_forever()
-    finally:
-        httpd.server_close()
-        # Drain the micro-batch scheduler so queued requests resolve before
-        # the process exits (late arrivals fall back to direct dispatch).
-        service.close()
-
-
-def make_server(
-    service: ScorerService, host: str = "127.0.0.1", port: int = 0
-) -> ThreadingHTTPServer:
-    """Build (but don't run) the server; port 0 picks a free port — used by
-    the in-process smoke tests."""
-    return ThreadingHTTPServer((host, port), make_handler(service))

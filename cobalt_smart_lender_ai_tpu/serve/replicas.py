@@ -19,7 +19,8 @@ spreads warmup traffic. A stalled replica's in-flight count stays high, so
 the router organically drains around it (`tests/test_replicas.py`).
 
 The facade duck-types the full `ScorerService` surface the HTTP adapters
-bind to (`make_server(service)` / `create_app(service)` work unchanged):
+bind to (`make_async_server(service)` / `create_app(service)` work
+unchanged):
 scoring endpoints route; `reload_from_store` is an atomic fleet swap — every
 replica builds + smoke-checks its candidate BEFORE any replica publishes, so
 a bad artifact rolls back everywhere and a good one lands everywhere;
@@ -130,6 +131,52 @@ class ReplicaSet:
                 fast_burn_threshold=config.slo_fast_burn_threshold,
             )
             self.slo.register_gauges()
+        # Fleet history (telemetry.timeseries + telemetry.aggregate): one
+        # sampler scrapes the facade registry PLUS every replica registry,
+        # merged — fleet-level sums next to per-replica series under a
+        # ``replica`` label, in one tiered ring store. The per-replica
+        # `ScorerService.history` stores stay un-started behind a facade:
+        # their source registries ride this merged scrape instead.
+        self.history: "TimeSeriesStore | None" = None
+        if config.history_enabled:
+            from cobalt_smart_lender_ai_tpu.telemetry.aggregate import (
+                merge_expositions,
+            )
+            from cobalt_smart_lender_ai_tpu.telemetry.metrics import (
+                parse_exposition,
+            )
+            from cobalt_smart_lender_ai_tpu.telemetry.timeseries import (
+                TimeSeriesStore,
+            )
+
+            def _fleet_scrape() -> dict:
+                # facade first with NO join labels (its request-level
+                # families are already fleet-level), then each replica
+                # joined under ``replica=i`` — so the merged exposition
+                # holds fleet sums and per-replica series side by side.
+                regs = [self.registry] + [r.registry for r in self.replicas]
+                extra = [{}] + [
+                    {"replica": str(i)}
+                    for i in range(len(self.replicas))
+                ]
+                return merge_expositions(
+                    [parse_exposition(r.render()) for r in regs],
+                    extra_labels=extra,
+                    keep_sources=True,
+                )
+
+            self.history = TimeSeriesStore(
+                scrape=_fleet_scrape,
+                interval_s=config.history_interval_s,
+                tiers=config.history_tiers,
+            )
+
+    def start_history(self) -> None:
+        """Start the fleet history sampler (idempotent) — the adapters
+        call this when their socket opens, same as the single-service
+        `ScorerService.start_history`."""
+        if self.history is not None:
+            self.history.start()
 
     @classmethod
     def from_store(
@@ -620,5 +667,7 @@ class ReplicaSet:
     def close(self) -> None:
         if self.canary is not None:
             self.canary.close()
+        if self.history is not None:
+            self.history.stop()
         for rep in self.replicas:
             rep.close()

@@ -933,6 +933,23 @@ class ScorerService:
                 fast_burn_threshold=self.config.slo_fast_burn_threshold,
             )
             self.slo.register_gauges()
+        # Telemetry history (telemetry.timeseries, README "Telemetry
+        # history & trends"): tiered downsampled rings over this service's
+        # registry, served at GET /history and /dashboard. Constructed here
+        # so the adapters can serve it, but the sampler thread only starts
+        # with the HTTP server (`start_history`) — bare in-process services
+        # never spawn it.
+        self.history: "TimeSeriesStore | None" = None
+        if self.config.history_enabled:
+            from cobalt_smart_lender_ai_tpu.telemetry.timeseries import (
+                TimeSeriesStore,
+            )
+
+            self.history = TimeSeriesStore(
+                registry=self.registry,
+                interval_s=self.config.history_interval_s,
+                tiers=self.config.history_tiers,
+            )
         # One reload at a time; request threads never take this lock — they
         # read `_model` once and run against that snapshot.
         self._swap_lock = threading.Lock()
@@ -1120,6 +1137,13 @@ class ScorerService:
         finally:
             self._observe_phase(name, sp.duration_s or 0.0)
 
+    def start_history(self) -> None:
+        """Start the history sampler thread (idempotent). Called by the
+        HTTP adapters when their socket opens — history is a serving
+        concern; in-process scoring shouldn't pay for a thread."""
+        if self.history is not None:
+            self.history.start()
+
     def close(self) -> None:
         """Stop the micro-batch worker (drains queued requests first);
         requests arriving afterwards score on the direct per-request path.
@@ -1128,6 +1152,8 @@ class ScorerService:
             self.canary.close()
         if self.batcher is not None:
             self.batcher.close()
+        if self.history is not None:
+            self.history.stop()
 
     # -- compiled-model delegation (stable public/observed surface) -----------
 

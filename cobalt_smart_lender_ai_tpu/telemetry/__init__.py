@@ -34,10 +34,25 @@ The performance observatory (README "Run observability") adds three legs:
   `DeviceSampler` feeding Perfetto counter tracks.
 - `telemetry.runledger` — one JSON `RunLedger` artifact per run, rendered
   and diffed by ``tools/obs_report.py``.
+
+The history layer (README "Telemetry history & trends") adds two more:
+
+- `telemetry.timeseries` — `TimeSeriesStore`: a background sampler
+  scraping any registry into tiered downsampled rings (counter rates,
+  per-window histogram quantiles), with durable md5-pinned segments and
+  the stdlib-HTML ``GET /dashboard`` renderer.
+- `telemetry.aggregate` — merge N `parse_exposition` snapshots into
+  fleet-level series (counter sums, histogram bucket merges, label
+  joins) for `ReplicaSet` fleets and, later, multi-host scrapes.
 """
 
 from __future__ import annotations
 
+from cobalt_smart_lender_ai_tpu.telemetry.aggregate import (
+    fleet_scraper,
+    merge_expositions,
+    merge_registries,
+)
 from cobalt_smart_lender_ai_tpu.telemetry.devices import (
     DeviceSampler,
     default_device_sampler,
@@ -86,6 +101,11 @@ from cobalt_smart_lender_ai_tpu.telemetry.runledger import (
     RunLedger,
     load_ledger,
 )
+from cobalt_smart_lender_ai_tpu.telemetry.timeseries import (
+    TimeSeriesStore,
+    load_segments,
+    render_dashboard,
+)
 from cobalt_smart_lender_ai_tpu.telemetry.slo import (
     Objective,
     SLOEngine,
@@ -125,6 +145,7 @@ __all__ = [
     "SLOEngine",
     "Span",
     "StructuredLogger",
+    "TimeSeriesStore",
     "Tracer",
     "add_phase",
     "chrome_trace",
@@ -137,12 +158,16 @@ __all__ = [
     "default_registry",
     "default_tracer",
     "device_info",
+    "fleet_scraper",
     "get_logger",
     "host_rss_bytes",
     "install_device_metrics",
     "install_program_metrics",
     "load_ledger",
+    "load_segments",
     "log_buckets",
+    "merge_expositions",
+    "merge_registries",
     "new_request_id",
     "parse_exposition",
     "program_table",
@@ -150,6 +175,7 @@ __all__ = [
     "record_span",
     "render",
     "render_chrome_trace",
+    "render_dashboard",
     "request_context",
     "span",
     "snapshot",

@@ -75,6 +75,8 @@ META_ROUTES: frozenset[str] = frozenset(
         "/debug/slowest",
         "/debug/trace",
         "/debug/programs",
+        "/history",
+        "/dashboard",
     }
 )
 

@@ -12,9 +12,9 @@ mode:
 - a hot reload racing an in-flight awaited batch never mixes models inside
   one batch;
 - the error taxonomy (422/400/404/429 shed/503 circuit_open/500
-  reload_failed) is IDENTICAL between the asyncio adapter and the
-  deprecated threaded rollback adapter, and scoring bodies are
-  byte-identical between the two;
+  reload_failed) holds exactly on the asyncio adapter, and scoring bodies
+  are byte-stable across server instances (the contract the removed
+  threaded adapter used to be pinned against);
 - the /readyz, /slo, /debug/*, /metrics (classic + OpenMetrics) contracts
   hold unchanged on the asyncio adapter;
 - request ids minted at ingress for id-less clients join across logs,
@@ -48,7 +48,6 @@ from cobalt_smart_lender_ai_tpu.reliability import (
     start_deadline,
 )
 from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
-from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
 from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 
 
@@ -76,23 +75,13 @@ def _valid_payload() -> dict:
 
 
 @contextlib.contextmanager
-def _serving(impl: str, service):
-    """Run ``service`` behind one adapter; yields the base URL."""
-    if impl == "asyncio":
-        server = make_async_server(service)
-        try:
-            yield f"http://127.0.0.1:{server.port}"
-        finally:
-            server.close()
-    else:
-        httpd = make_server(service)
-        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
-        thread.start()
-        try:
-            yield f"http://127.0.0.1:{httpd.server_address[1]}"
-        finally:
-            httpd.shutdown()
-            httpd.server_close()
+def _serving(service):
+    """Run ``service`` behind the asyncio adapter; yields the base URL."""
+    server = make_async_server(service)
+    try:
+        yield f"http://127.0.0.1:{server.port}"
+    finally:
+        server.close()
 
 
 def _request(url, data=None, content_type="application/json", headers=None):
@@ -252,13 +241,13 @@ def test_hot_reload_mid_await_never_mixes_models(tmp_path, serving_artifact):
         svc.close()
 
 
-# --- taxonomy + byte parity against the threaded rollback adapter -------------
+# --- taxonomy + byte-stability (the removed threaded adapter's coverage) ------
 
 
-def _taxonomy_trace(impl: str, tmp_path, serving_artifact) -> list[tuple]:
+def _taxonomy_trace(tag: str, tmp_path, serving_artifact) -> list[tuple]:
     shared, _ = serving_artifact
     art = GBDTArtifact.load(shared, "models/gbdt/model_tree")
-    store = ObjectStore(str(tmp_path / f"lake-{impl}"))
+    store = ObjectStore(str(tmp_path / f"lake-{tag}"))
     art.save(store, "models/gbdt/model_tree")
     flaky = FaultInjectingStore(store, faults={})
     svc = ScorerService.from_store(
@@ -282,7 +271,7 @@ def _taxonomy_trace(impl: str, tmp_path, serving_artifact) -> list[tuple]:
         return status, parsed
 
     try:
-        with _serving(impl, svc) as base:
+        with _serving(svc) as base:
             probe("/predict", ok)  # 200
             probe("/predict", b"{}")  # 422 invalid_input
             probe("/feature_importance_bulk", b'{"data": []}')  # 400
@@ -302,35 +291,31 @@ def _taxonomy_trace(impl: str, tmp_path, serving_artifact) -> list[tuple]:
     return trace
 
 
-def test_error_taxonomy_identical_across_adapters(tmp_path, serving_artifact):
-    """Admission 429, breaker 503, and the 4xx taxonomy present identical
-    (status, error-code, Retry-After) sequences on the asyncio adapter and
-    the threaded rollback adapter."""
-    traces = {
-        impl: _taxonomy_trace(impl, tmp_path, serving_artifact)
-        for impl in ("asyncio", "threaded")
-    }
-    assert traces["asyncio"] == traces["threaded"]
-    statuses = [s for _, s, _, _ in traces["asyncio"]]
+def test_error_taxonomy_exact_sequence(tmp_path, serving_artifact):
+    """Admission 429, breaker 503, and the 4xx taxonomy present the exact
+    (status, error-code, Retry-After) sequence the removed threaded adapter
+    was pinned to — the contract survives the adapter."""
+    trace = _taxonomy_trace("asyncio", tmp_path, serving_artifact)
+    statuses = [s for _, s, _, _ in trace]
     assert statuses == [200, 422, 400, 404, 429, 500, 500, 500, 503]
-    codes = [c for _, _, c, _ in traces["asyncio"]]
+    codes = [c for _, _, c, _ in trace]
     assert codes[1] == "invalid_input"
     assert codes[4] == "shed"
     assert codes[5:8] == ["reload_failed"] * 3
     assert codes[8] == "circuit_open"
-    retry_after = [ra for _, _, _, ra in traces["asyncio"]]
+    retry_after = [ra for _, _, _, ra in trace]
     assert retry_after[4] and retry_after[8]  # shed + circuit_open carry it
 
 
-def test_adapters_serve_byte_identical_bodies(serving_artifact):
-    """The rollback guarantee: until the threaded adapter is removed, both
-    frontends over one service return byte-for-byte identical bodies for
-    every deterministic route."""
+def test_bodies_byte_stable_across_server_instances(serving_artifact):
+    """Two independent server instances over one service return
+    byte-for-byte identical bodies for every deterministic route — the
+    serialization-stability half of the old adapter byte-parity pin."""
     from cobalt_smart_lender_ai_tpu.data import schema
 
     store, X = serving_artifact
-    # cache off: both adapters compute every response through the batcher,
-    # so a hit-vs-miss difference can never masquerade as adapter parity
+    # cache off: every response goes through the batcher, so a hit-vs-miss
+    # difference can never masquerade as serialization stability
     svc = ScorerService.from_store(
         store, _cfg(microbatch_enabled=True, score_cache_size=0)
     )
@@ -356,16 +341,16 @@ def test_adapters_serve_byte_identical_bodies(serving_artifact):
     ]
     try:
         observed: dict[str, list] = {}
-        for impl in ("asyncio", "threaded"):
-            with _serving(impl, svc) as base:
-                observed[impl] = [
+        for run in ("first", "second"):
+            with _serving(svc) as base:
+                observed[run] = [
                     _request(base + path, data, ct)[:2]
                     for path, data, ct in probes
                 ]
-        for (path, _, _), a, t in zip(
-            probes, observed["asyncio"], observed["threaded"]
+        for (path, _, _), a, b in zip(
+            probes, observed["first"], observed["second"]
         ):
-            assert a == t, f"{path}: asyncio {a} != threaded {t}"
+            assert a == b, f"{path}: first {a} != second {b}"
     finally:
         svc.close()
 
@@ -382,7 +367,7 @@ def test_asyncio_adapter_observability_contracts(serving_artifact):
     svc = ScorerService.from_store(store, _cfg(microbatch_enabled=True))
     ok = json.dumps(_valid_payload()).encode()
     try:
-        with _serving("asyncio", svc) as base:
+        with _serving(svc) as base:
             for _ in range(3):
                 status, _, _ = _request(base + "/predict", ok)
                 assert status == 200
@@ -455,7 +440,7 @@ def test_request_id_minted_at_ingress_joins_everything(serving_artifact):
     )
     ok = json.dumps(_valid_payload()).encode()
     try:
-        with _serving("asyncio", svc) as base:
+        with _serving(svc) as base:
             status, _, headers = _request(base + "/predict", ok)
             assert status == 200
             rid = headers["X-Request-ID"]
@@ -600,7 +585,7 @@ def test_async_chaos_soak_zero_untyped_500s(tmp_path, serving_artifact):
                 results.append((path, status, body))
 
     try:
-        with _serving("asyncio", svc) as base:
+        with _serving(svc) as base:
             threads = [
                 threading.Thread(target=hammer, args=(i,), daemon=True)
                 for i in range(4)

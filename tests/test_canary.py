@@ -7,7 +7,6 @@ only, pointers never torn, canary scores never in a caller's response)."""
 
 import json
 import shutil
-import threading
 import urllib.error
 import urllib.request
 
@@ -335,17 +334,14 @@ def _http(base, path, payload=None, method=None):
 
 @pytest.fixture
 def live_service(lake):
-    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
 
     svc = ScorerService.from_store(lake, _cfg())
-    httpd = make_server(svc, "127.0.0.1", 0)
-    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
-    thread.start()
+    server = make_async_server(svc, "127.0.0.1", 0)
     try:
-        yield svc, f"http://127.0.0.1:{httpd.server_address[1]}"
+        yield svc, f"http://127.0.0.1:{server.port}"
     finally:
-        httpd.shutdown()
-        httpd.server_close()
+        server.close()
         svc.close()
 
 
@@ -395,7 +391,7 @@ def test_canary_cycle_under_faults_yields_typed_errors_only(lake, serving_artifa
         FaultInjectingStore,
         FaultSpec,
     )
-    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
     from cobalt_smart_lender_ai_tpu.telemetry import MetricsRegistry
 
     _, X = serving_artifact
@@ -416,9 +412,8 @@ def test_canary_cycle_under_faults_yields_typed_errors_only(lake, serving_artifa
         verify_reads=True,
     )
     svc = ScorerService.from_store(store, _cfg(canary_min_samples=4))
-    httpd = make_server(svc, "127.0.0.1", 0)
-    base = f"http://127.0.0.1:{httpd.server_address[1]}"
-    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    server = make_async_server(svc, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{server.port}"
 
     allowed_codes = {
         "promotion_rejected", "rollback_failed", "reload_failed",
@@ -488,6 +483,5 @@ def test_canary_cycle_under_faults_yields_typed_errors_only(lake, serving_artifa
             s < 500 or b.get("error") in allowed_codes for s, b in observed
         )
     finally:
-        httpd.shutdown()
-        httpd.server_close()
+        server.close()
         svc.close()

@@ -6,7 +6,6 @@ latency")."""
 
 import json
 import logging
-import threading
 import urllib.request
 
 import pytest
@@ -442,7 +441,7 @@ def test_slow_request_visible_end_to_end(serving_artifact):
     import time
 
     from cobalt_smart_lender_ai_tpu.config import ServeConfig
-    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
     from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 
     store, _ = serving_artifact
@@ -462,10 +461,8 @@ def test_slow_request_visible_end_to_end(serving_artifact):
         return orig_shap(*args, **kwargs)
 
     svc._model.shap_fn = slow_shap
-    httpd = make_server(svc, "127.0.0.1", 0)
-    base = f"http://127.0.0.1:{httpd.server_address[1]}"
-    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
-    thread.start()
+    server = make_async_server(svc, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{server.port}"
 
     def get(path):
         with urllib.request.urlopen(base + path, timeout=30) as resp:
@@ -511,6 +508,5 @@ def test_slow_request_visible_end_to_end(serving_artifact):
         assert by_name["availability"]["bad"] == 0
         assert not by_name["availability"]["fast_burn"]
     finally:
-        httpd.shutdown()
-        httpd.server_close()
+        server.close()
         svc.close()

@@ -5,7 +5,6 @@ routes' limit/phase query validation."""
 
 import json
 import math
-import threading
 import urllib.error
 import urllib.request
 
@@ -166,7 +165,7 @@ def _get(url):
 @pytest.fixture()
 def observatory_server(serving_artifact, fresh_programs):
     from cobalt_smart_lender_ai_tpu.config import ServeConfig
-    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
     from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 
     store, X = serving_artifact
@@ -174,12 +173,9 @@ def observatory_server(serving_artifact, fresh_programs):
         store,
         ServeConfig(precompile_batch_buckets=(), microbatch_enabled=False),
     )
-    httpd = make_server(svc, "127.0.0.1", 0)
-    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
-    thread.start()
-    yield f"http://127.0.0.1:{httpd.server_address[1]}", svc, X
-    httpd.shutdown()
-    httpd.server_close()
+    server = make_async_server(svc, "127.0.0.1", 0)
+    yield f"http://127.0.0.1:{server.port}", svc, X
+    server.close()
     svc.close()
 
 

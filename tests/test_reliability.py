@@ -597,18 +597,16 @@ def test_degrade_disabled_raises(serving_artifact, monkeypatch):
         service_mod.ScorerService.from_store(store, cfg)
 
 
-def test_stdlib_adapter_degraded_and_health(degraded_service):
+def test_asyncio_adapter_degraded_and_health(degraded_service):
     """ISSUE acceptance: POST /predict over real HTTP returns 200 with
     degraded=true and a valid prob_default; /healthz + /readyz respond."""
     import http.client
 
-    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
 
-    httpd = make_server(degraded_service)
-    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
-    thread.start()
+    server = make_async_server(degraded_service)
     try:
-        host, port = httpd.server_address[:2]
+        host, port = "127.0.0.1", server.port
 
         def request(method: str, path: str, body: bytes | None = None):
             conn = http.client.HTTPConnection(host, port, timeout=30)
@@ -633,9 +631,7 @@ def test_stdlib_adapter_degraded_and_health(degraded_service):
         assert ready["shap"] == "degraded"
         assert ready["compiled_batch_buckets"]
     finally:
-        httpd.shutdown()
-        httpd.server_close()
-        thread.join(timeout=5)
+        server.close()
 
 
 def test_fastapi_adapter_degraded_and_health(degraded_service, monkeypatch):

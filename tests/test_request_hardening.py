@@ -7,7 +7,7 @@ time), then the HTTP surface is exercised through the stdlib adapter so the
 status codes, bodies and ``Retry-After`` headers of the taxonomy
 (`reliability.errors`) are asserted on the wire. The chaos soak at the bottom
 (marked ``slow`` + ``faults``; run by the CI ``faults`` job and excluded from
-tier-1) drives the real threaded server under injected store faults and
+tier-1) drives the real asyncio server under injected store faults and
 latency while hot-swapping models concurrently, and asserts the ISSUE's
 headline: zero untyped 500s — every failure a client sees is a policy
 decision with a machine-readable code, not a bug escape.
@@ -46,7 +46,7 @@ from cobalt_smart_lender_ai_tpu.reliability import (
     TokenBucket,
     start_deadline,
 )
-from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
 from cobalt_smart_lender_ai_tpu.serve.service import (
     SINGLE_INPUT_FIELDS,
     ScorerService,
@@ -107,14 +107,11 @@ def _request(url: str, data: bytes | None = None, content_type: str = "applicati
 
 @contextlib.contextmanager
 def _running(service: ScorerService):
-    httpd = make_server(service)
-    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
-    thread.start()
+    server = make_async_server(service)
     try:
-        yield f"http://127.0.0.1:{httpd.server_address[1]}"
+        yield f"http://127.0.0.1:{server.port}"
     finally:
-        httpd.shutdown()
-        httpd.server_close()
+        server.close()
 
 
 def _csv_bytes(X: np.ndarray, n: int) -> bytes:

@@ -4,7 +4,6 @@ two aliased names, and the stdlib HTTP adapter end-to-end over a socket."""
 
 import io
 import json
-import threading
 import urllib.request
 
 import numpy as np
@@ -181,18 +180,16 @@ def test_bulk_scoring_shape_buckets(serving_artifact):
     assert p150.shape == (150,)
 
 
-# --- stdlib HTTP adapter end-to-end ------------------------------------------
+# --- asyncio HTTP adapter end-to-end -----------------------------------------
 
 
 @pytest.fixture(scope="module")
 def http_server(service):
-    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
 
-    httpd = make_server(service, "127.0.0.1", 0)
-    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
-    thread.start()
-    yield f"http://127.0.0.1:{httpd.server_address[1]}"
-    httpd.shutdown()
+    server = make_async_server(service, "127.0.0.1", 0)
+    yield f"http://127.0.0.1:{server.port}"
+    server.close()
 
 
 def _post(url, body: bytes, content_type: str):

@@ -221,6 +221,8 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
         "/debug/slowest",
         "/debug/trace",
         "/debug/programs",
+        "/history",
+        "/dashboard",
     }
 
     # health/readiness GET routes: healthy service -> ok, shap ok, 200 path

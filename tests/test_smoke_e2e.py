@@ -4,7 +4,6 @@ from the engineered tree frame, scored through the *served HTTP API*, and
 checked against their true labels instead of eyeballed."""
 
 import json
-import threading
 import urllib.request
 
 import numpy as np
@@ -26,7 +25,7 @@ def _fast_cfg():
 
     return ServeConfig(prewarm_all_buckets=False)
 
-from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
 
 
 @pytest.fixture(scope="module")
@@ -53,9 +52,8 @@ def smoke_env(tmp_path_factory, engineered):
         feature_names=tuple(schema.SERVING_FEATURES),
     ).save(store, "models/gbdt/model_tree")
     service = ScorerService.from_store(store, _fast_cfg())
-    httpd = make_server(service, "127.0.0.1", 0)
-    threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    server = make_async_server(service, "127.0.0.1", 0)
+    url = f"http://127.0.0.1:{server.port}"
 
     # 10-row labeled sample, balanced like a smoke operator would pick
     # (automation_test.py samples 10 rows and prints the labels).
@@ -71,7 +69,7 @@ def smoke_env(tmp_path_factory, engineered):
     sample = pd.DataFrame(Xte[idx], columns=list(schema.SERVING_FEATURES))
     labels = yte[idx]
     yield url, sample, labels
-    httpd.shutdown()
+    server.close()
 
 
 def _post(url, body, content_type):

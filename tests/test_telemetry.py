@@ -285,21 +285,19 @@ def test_request_ids_cross_the_batcher_thread_boundary(serving_artifact):
     svc.close()
 
 
-# --- stdlib adapter: /metrics + X-Request-ID ----------------------------------
+# --- asyncio adapter: /metrics + X-Request-ID ---------------------------------
 
 
 @pytest.fixture()
 def telemetry_http(serving_artifact):
-    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
     from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 
     store, _ = serving_artifact
     svc = ScorerService.from_store(store, _cfg())
-    httpd = make_server(svc, "127.0.0.1", 0)
-    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
-    thread.start()
-    yield f"http://127.0.0.1:{httpd.server_address[1]}", svc
-    httpd.shutdown()
+    server = make_async_server(svc, "127.0.0.1", 0)
+    yield f"http://127.0.0.1:{server.port}", svc
+    server.close()
     svc.close()
 
 

@@ -1,0 +1,753 @@
+"""Telemetry history + trend gate tests: fake-clock downsampling math for
+`telemetry.timeseries`, fleet-merge properties for `telemetry.aggregate`,
+the `/history` + `/dashboard` HTTP contract on both adapters (with the
+typed 422 taxonomy), durable segment round-trips under injected store
+faults, and the `tools/perf_sentinel.py` exit-code matrix."""
+
+import json
+import math
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from cobalt_smart_lender_ai_tpu.io import ObjectStore
+from cobalt_smart_lender_ai_tpu.reliability import (
+    FaultInjectingStore,
+    FaultSpec,
+)
+from cobalt_smart_lender_ai_tpu.telemetry.aggregate import (
+    join_sample_key,
+    merge_expositions,
+    merge_registries,
+    split_sample_key,
+)
+from cobalt_smart_lender_ai_tpu.telemetry.metrics import MetricsRegistry
+from cobalt_smart_lender_ai_tpu.telemetry.timeseries import (
+    TimeSeriesStore,
+    load_segments,
+    render_dashboard,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _expo(counters=None, gauges=None, hist=None):
+    """Build a parse_exposition-shaped snapshot from plain dicts.
+    ``hist`` maps family -> ({le: cumulative}, count)."""
+    out = {}
+    for name, v in (counters or {}).items():
+        out[name] = {"type": "counter", "samples": {name: float(v)}}
+    for name, v in (gauges or {}).items():
+        out[name] = {"type": "gauge", "samples": {name: float(v)}}
+    for fam, (buckets, count) in (hist or {}).items():
+        samples = {}
+        for le, c in buckets.items():
+            tag = "+Inf" if math.isinf(le) else f"{le:g}"
+            samples[f"{fam}_bucket|le={tag}"] = float(c)
+        samples[f"{fam}_count"] = float(count)
+        samples[f"{fam}_sum"] = 0.0
+        out[fam] = {"type": "histogram", "samples": samples}
+    return out
+
+
+# --- fake-clock sampling math -------------------------------------------------
+
+
+def test_counter_becomes_windowed_rate():
+    clock = FakeClock()
+    snap = {"cum": 0.0}
+    ts = TimeSeriesStore(
+        scrape=lambda: _expo(counters={"reqs_total": snap["cum"]}),
+        clock=clock,
+        tiers=((1.0, 16), (10.0, 16)),
+    )
+    ts.sample_once()  # first tick: establishes the baseline, no point
+    clock.t, snap["cum"] = 1.0, 5.0
+    ts.sample_once()
+    clock.t, snap["cum"] = 2.0, 15.0
+    ts.sample_once()
+    fine = ts.query("reqs_total:rate", step_s=1.0)
+    assert fine["tier_s"] == 1.0
+    assert fine["points"] == [[1.0, 5.0], [2.0, 10.0]]
+    # the 10s tier accumulates both deltas into one bucket: 15 obs / 2 s
+    coarse = ts.query("reqs_total:rate", step_s=10.0)
+    assert coarse["points"] == [[0.0, 7.5]]
+
+
+def test_counter_reset_treated_as_fresh_delta():
+    clock = FakeClock()
+    snap = {"cum": 100.0}
+    ts = TimeSeriesStore(
+        scrape=lambda: _expo(counters={"reqs_total": snap["cum"]}),
+        clock=clock,
+        tiers=((1.0, 16),),
+    )
+    ts.sample_once()
+    clock.t, snap["cum"] = 1.0, 3.0  # process restarted behind the scrape
+    ts.sample_once()
+    assert ts.query("reqs_total:rate")["points"] == [[1.0, 3.0]]
+
+
+def test_gauge_last_value_wins_within_bucket():
+    clock = FakeClock()
+    snap = {"v": 1.0}
+    ts = TimeSeriesStore(
+        scrape=lambda: _expo(gauges={"depth": snap["v"]}),
+        clock=clock,
+        tiers=((10.0, 8),),
+    )
+    for t, v in ((0.0, 1.0), (4.0, 9.0), (8.0, 2.0), (12.0, 7.0)):
+        clock.t, snap["v"] = t, v
+        ts.sample_once()
+    assert ts.query("depth")["points"] == [[0.0, 2.0], [10.0, 7.0]]
+
+
+def test_histogram_quantiles_interpolate_within_window():
+    clock = FakeClock()
+    state = {"buckets": {0.1: 0.0, 1.0: 0.0, math.inf: 0.0}, "count": 0.0}
+    ts = TimeSeriesStore(
+        scrape=lambda: _expo(hist={"lat": (state["buckets"], state["count"])}),
+        clock=clock,
+        tiers=((1.0, 16),),
+    )
+    ts.sample_once()
+    # window 1: all 10 observations land below 0.1s
+    clock.t = 1.0
+    state["buckets"] = {0.1: 10.0, 1.0: 10.0, math.inf: 10.0}
+    state["count"] = 10.0
+    ts.sample_once()
+    p50 = ts.query("lat:p50")["points"]
+    p99 = ts.query("lat:p99")["points"]
+    assert p50[-1] == [1.0, pytest.approx(0.05)]  # rank 5 of 10 in [0, 0.1]
+    assert p99[-1] == [1.0, pytest.approx(0.099)]
+    # window 2: 8 obs in (0.1, 1], 2 in (1, +Inf) -> p50 interpolates the
+    # middle bucket, p999 clamps to the +Inf bucket's lower edge
+    clock.t = 2.0
+    state["buckets"] = {0.1: 10.0, 1.0: 18.0, math.inf: 20.0}
+    state["count"] = 20.0
+    ts.sample_once()
+    assert ts.query("lat:p50")["points"][-1] == [
+        2.0,
+        pytest.approx(0.1 + 0.9 * 5 / 8),
+    ]
+    assert ts.query("lat:p999")["points"][-1] == [2.0, pytest.approx(1.0)]
+    # the histogram count doubles as the QPS series
+    assert ts.query("lat:rate")["points"] == [[1.0, 10.0], [2.0, 10.0]]
+
+
+def test_empty_window_emits_no_quantile_point():
+    clock = FakeClock()
+    state = {"buckets": {1.0: 5.0, math.inf: 5.0}, "count": 5.0}
+    ts = TimeSeriesStore(
+        scrape=lambda: _expo(hist={"lat": (state["buckets"], state["count"])}),
+        clock=clock,
+        tiers=((1.0, 16),),
+    )
+    ts.sample_once()
+    clock.t = 1.0  # no new observations
+    ts.sample_once()
+    with pytest.raises(KeyError):
+        ts.query("lat:p50")
+
+
+def test_query_tier_selection_and_unknown_series():
+    clock = FakeClock()
+    ts = TimeSeriesStore(
+        scrape=lambda: _expo(gauges={"g": 1.0}),
+        clock=clock,
+        tiers=((10.0, 360), (60.0, 720)),
+    )
+    ts.sample_once()
+    assert ts.query("g")["tier_s"] == 10.0  # default: finest
+    # a window wider than the finest ring's span escalates tiers
+    assert ts.query("g", window_s=5000.0)["tier_s"] == 60.0
+    assert ts.query("g", step_s=60.0)["tier_s"] == 60.0
+    with pytest.raises(KeyError):
+        ts.query("nope")
+    assert ts.series_names() == ["g"]
+    assert ts.tiers() == [
+        {"width_s": 10.0, "capacity": 360},
+        {"width_s": 60.0, "capacity": 720},
+    ]
+
+
+def test_scrape_fault_never_kills_the_sampler():
+    clock = FakeClock()
+    calls = {"n": 0}
+
+    def scrape():
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("transient scrape fault")
+        return _expo(gauges={"g": float(calls["n"])})
+
+    ts = TimeSeriesStore(scrape=scrape, clock=clock, tiers=((1.0, 8),))
+    for t in (0.0, 1.0, 2.0):
+        clock.t = t
+        ts.sample_once()
+    assert ts.sample_errors == 1
+    assert ts.query("g")["points"] == [[0.0, 1.0], [2.0, 3.0]]
+
+
+def test_exactly_one_of_registry_or_scrape():
+    with pytest.raises(ValueError):
+        TimeSeriesStore()
+    with pytest.raises(ValueError):
+        TimeSeriesStore(registry=MetricsRegistry(), scrape=lambda: {})
+
+
+# --- fleet aggregation --------------------------------------------------------
+
+
+def _snap_a():
+    return _expo(counters={"reqs_total": 10.0}, gauges={"depth": 2.0})
+
+
+def _snap_b():
+    return _expo(counters={"reqs_total": 32.0}, gauges={"depth": 5.0})
+
+
+def test_merge_is_commutative_and_sums_counters():
+    ab = merge_expositions([_snap_a(), _snap_b()])
+    ba = merge_expositions([_snap_b(), _snap_a()])
+    assert ab == ba
+    assert ab["reqs_total"]["samples"]["reqs_total"] == 42.0
+    assert ab["depth"]["samples"]["depth"] == 7.0
+
+
+def test_merge_is_associative():
+    snaps = [_snap_a(), _snap_b(), _expo(counters={"reqs_total": 0.5})]
+    once = merge_expositions(snaps)
+    paired = merge_expositions(
+        [merge_expositions(snaps[:2]), snaps[2]]
+    )
+    assert once == paired
+
+
+def test_merge_keeps_per_source_series_under_joined_labels():
+    merged = merge_expositions(
+        [_snap_a(), _snap_b()],
+        extra_labels=[{"replica": "0"}, {"replica": "1"}],
+        keep_sources=True,
+    )
+    samples = merged["reqs_total"]["samples"]
+    assert samples["reqs_total"] == 42.0
+    assert samples["reqs_total|replica=0"] == 10.0
+    assert samples["reqs_total|replica=1"] == 32.0
+
+
+def test_merge_skips_nan_and_rejects_type_conflicts():
+    healthy = _expo(gauges={"depth": 3.0})
+    dead = _expo(gauges={"depth": math.nan})
+    merged = merge_expositions([healthy, dead])
+    assert merged["depth"]["samples"]["depth"] == 3.0
+    with pytest.raises(ValueError, match="conflicts"):
+        merge_expositions(
+            [
+                {"x": {"type": "counter", "samples": {"x": 1.0}}},
+                {"x": {"type": "histogram", "samples": {}}},
+            ]
+        )
+
+
+def test_sample_key_round_trip():
+    name, labels = split_sample_key("lat_bucket|le=0.5|route=/predict")
+    assert name == "lat_bucket"
+    assert labels == {"le": "0.5", "route": "/predict"}
+    assert join_sample_key(name, labels) == "lat_bucket|le=0.5|route=/predict"
+
+
+def test_two_replica_fleet_counter_equals_sum_of_members():
+    """The acceptance invariant: the fleet-level counter series is
+    exactly the sum of the per-replica series, and both are scrapeable
+    into one history store."""
+    regs = [MetricsRegistry(), MetricsRegistry()]
+    for i, reg in enumerate(regs):
+        reg.counter("cobalt_requests_total", "requests").inc(10.0 * (i + 1))
+    merged = merge_registries(regs)
+    samples = merged["cobalt_requests_total"]["samples"]
+    assert samples["cobalt_requests_total"] == pytest.approx(
+        samples["cobalt_requests_total|replica=0"]
+        + samples["cobalt_requests_total|replica=1"]
+    )
+    # and through a history store: fleet rate == sum of per-replica rates
+    from cobalt_smart_lender_ai_tpu.telemetry.aggregate import fleet_scraper
+
+    clock = FakeClock()
+    ts = TimeSeriesStore(
+        scrape=fleet_scraper(regs), clock=clock, tiers=((1.0, 8),)
+    )
+    ts.sample_once()
+    clock.t = 1.0
+    regs[0].counter("cobalt_requests_total", "requests").inc(4.0)
+    regs[1].counter("cobalt_requests_total", "requests").inc(6.0)
+    ts.sample_once()
+    rate = lambda s: ts.query(s)["points"][-1][1]  # noqa: E731
+    assert rate("cobalt_requests_total:rate") == pytest.approx(
+        rate("cobalt_requests_total:rate|replica=0")
+        + rate("cobalt_requests_total:rate|replica=1")
+    )
+
+
+# --- durable segments ---------------------------------------------------------
+
+
+def _gauge_store(tmp_path, clock, store, **kw):
+    snap = {"v": 0.0}
+    ts = TimeSeriesStore(
+        scrape=lambda: _expo(gauges={"g": snap["v"]}),
+        clock=clock,
+        tiers=((1.0, 64),),
+        store=store,
+        ship_interval_s=0.0,  # ship only when the test says so
+        **kw,
+    )
+    return ts, snap
+
+
+def test_segment_ship_and_load_round_trip(tmp_path):
+    store = ObjectStore(str(tmp_path / "lake"))
+    clock = FakeClock()
+    ts, snap = _gauge_store(tmp_path, clock, store)
+    for t in (0.0, 1.0, 2.0):
+        clock.t, snap["v"] = t, t * 10
+        ts.sample_once()
+    key = ts.ship()
+    assert key is not None and store.verify_pointer(key)
+    assert ts.ship() is None  # nothing new since
+    clock.t, snap["v"] = 3.0, 30.0
+    ts.sample_once()
+    assert ts.ship() is not None  # append-only second segment
+    assert load_segments(store)["g"] == [
+        [0.0, 0.0],
+        [1.0, 10.0],
+        [2.0, 20.0],
+        [3.0, 30.0],
+    ]
+
+
+def test_failed_ship_reships_same_points(tmp_path):
+    inner = ObjectStore(str(tmp_path / "lake"))
+    faulty = FaultInjectingStore(
+        inner, faults={"put": FaultSpec(fail_after=0, max_faults=2)}
+    )
+    clock = FakeClock()
+    ts, snap = _gauge_store(tmp_path, clock, faulty)
+    ts.ship_interval_s = 0.5  # every tick is ship-due
+    for t in (0.0, 1.0, 2.0):
+        clock.t, snap["v"] = t, t
+        ts.sample_once()  # shipping faults are swallowed and counted
+    assert ts.ship_failures >= 1
+    clock.t, snap["v"] = 3.0, 3.0
+    ts.sample_once()  # fault budget spent: this ship lands
+    assert load_segments(inner)["g"] == [
+        [0.0, 0.0],
+        [1.0, 1.0],
+        [2.0, 2.0],
+        [3.0, 3.0],
+    ]
+
+
+def test_torn_segment_is_a_gap_not_a_crash(tmp_path):
+    store = ObjectStore(str(tmp_path / "lake"))
+    clock = FakeClock()
+    ts, snap = _gauge_store(tmp_path, clock, store)
+    clock.t = 0.0
+    ts.sample_once()
+    first = ts.ship()
+    clock.t, snap["v"] = 1.0, 5.0
+    ts.sample_once()
+    second = ts.ship()
+    store.put_bytes(first, b'{"torn')  # md5 pointer no longer verifies
+    loaded = load_segments(store)
+    assert loaded["g"] == [[1.0, 5.0]]  # torn segment skipped, rest intact
+    assert store.verify_pointer(second)
+
+
+def test_segment_gc_retains_newest(tmp_path):
+    store = ObjectStore(str(tmp_path / "lake"))
+    clock = FakeClock()
+    ts, snap = _gauge_store(tmp_path, clock, store, retain_segments=2)
+    for t in range(5):
+        clock.t, snap["v"] = float(t), float(t)
+        ts.sample_once()
+        ts.ship()
+    from cobalt_smart_lender_ai_tpu.io.store import PTR_SUFFIX
+
+    segs = [
+        k
+        for k in store.list("telemetry/history/")
+        if not k.endswith(PTR_SUFFIX)
+    ]
+    assert len(segs) == 2
+    # newest points survived GC
+    assert load_segments(store)["g"] == [[3.0, 3.0], [4.0, 4.0]]
+
+
+# --- HTTP contract: /history + /dashboard on both adapters --------------------
+
+
+def _history_cfg():
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+
+    return ServeConfig(
+        prewarm_all_buckets=False,
+        microbatch_enabled=False,
+        history_interval_s=0.03,
+        history_tiers=((0.05, 400), (1.0, 120), (60.0, 60)),
+    )
+
+
+@pytest.fixture(scope="module")
+def history_server(serving_artifact):
+    from cobalt_smart_lender_ai_tpu.serve import ScorerService
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import (
+        make_async_server,
+    )
+
+    store, _ = serving_artifact
+    service = ScorerService.from_store(store, _history_cfg())
+    server = make_async_server(service, "127.0.0.1", 0)
+    yield f"http://127.0.0.1:{server.port}", service
+    server.close()
+    service.close()
+
+
+def _get(url: str):
+    try:
+        with urllib.request.urlopen(url) as r:
+            ctype = r.headers.get("Content-Type", "")
+            return r.status, ctype, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type", ""), e.read()
+
+
+def _get_json(url: str):
+    status, _, body = _get(url)
+    return status, json.loads(body.decode())
+
+
+def _predict_payload():
+    from cobalt_smart_lender_ai_tpu.data import schema
+    from cobalt_smart_lender_ai_tpu.serve.service import SINGLE_INPUT_FIELDS
+
+    return {
+        canonical: 1 if canonical in schema.SERVING_INT_FEATURES else 1.5
+        for canonical in SINGLE_INPUT_FIELDS.values()
+    }
+
+
+def test_live_history_latency_quantiles_span_windows(history_server):
+    """Acceptance: under sustained load, /history on the asyncio adapter
+    returns a latency-quantile series spanning >= 3 sample windows."""
+    url, _ = history_server
+    body = json.dumps(_predict_payload()).encode()
+    series = "cobalt_request_latency_seconds:p99|route=/predict|status=200"
+    deadline = time.monotonic() + 30.0
+    points = []
+    while time.monotonic() < deadline:
+        for _ in range(8):
+            req = urllib.request.Request(
+                url + "/predict",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as r:
+                assert r.status == 200
+        status, doc = _get_json(
+            url + "/history?series=" + urllib.parse.quote(series)
+        )
+        if status == 200:
+            points = doc["points"]
+            if len(points) >= 3:
+                break
+    assert len(points) >= 3, f"only {len(points)} windows sampled"
+    assert len({t for t, _ in points}) == len(points)  # distinct windows
+    assert all(v >= 0 for _, v in points)
+    assert doc["tier_s"] == 0.05
+    # the same traffic also produced a QPS series (histogram _count rate)
+    status, doc = _get_json(
+        url
+        + "/history?series="
+        + urllib.parse.quote(
+            "cobalt_request_latency_seconds:rate|route=/predict|status=200"
+        )
+    )
+    assert status == 200 and len(doc["points"]) >= 1
+
+
+def test_history_catalog_and_window_param(history_server):
+    url, _ = history_server
+    status, doc = _get_json(url + "/history")
+    assert status == 200
+    assert set(doc) == {"series", "tiers"}
+    assert doc["tiers"][0] == {"width_s": 0.05, "capacity": 400}
+    status, doc = _get_json(url + "/history?series=" + urllib.parse.quote(
+        doc["series"][0]) + "&window=10")
+    assert status == 200 and doc["tier_s"] == 0.05
+    # a window wider than the finest ring escalates to a coarser tier
+    status, doc = _get_json(url + "/history?series=" + urllib.parse.quote(
+        doc["series"]) + "&window=3000")
+    assert status == 200 and doc["tier_s"] == 60.0
+
+
+def test_history_422_taxonomy_asyncio(history_server):
+    url, _ = history_server
+    status, doc = _get_json(url + "/history?series=no_such_series")
+    assert status == 422
+    assert doc["error"] == "invalid_input"
+    assert "unknown series" in doc["detail"]
+    for bad in ("window=abc", "window=-5", "step=0", "window=inf"):
+        status, doc = _get_json(url + "/history?series=x&" + bad)
+        assert status == 422, bad
+        assert doc["error"] == "invalid_input"
+
+
+def test_dashboard_html_asyncio(history_server):
+    url, _ = history_server
+    status, ctype, body = _get(url + "/dashboard")
+    assert status == 200
+    assert ctype.startswith("text/html")
+    text = body.decode()
+    assert "<svg" in text or "no samples yet" in text
+    assert "Latency quantiles" in text
+    status, doc = _get_json(url + "/dashboard?window=nope")
+    assert status == 422 and doc["error"] == "invalid_input"
+
+
+def test_history_disabled_404(serving_artifact):
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+    from cobalt_smart_lender_ai_tpu.serve import ScorerService
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import (
+        make_async_server,
+    )
+
+    store, _ = serving_artifact
+    service = ScorerService.from_store(
+        store,
+        ServeConfig(
+            prewarm_all_buckets=False,
+            microbatch_enabled=False,
+            history_enabled=False,
+        ),
+    )
+    server = make_async_server(service, "127.0.0.1", 0)
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        for route in ("/history", "/dashboard"):
+            status, doc = _get_json(url + route)
+            assert status == 404
+            assert doc["error"] == "history_disabled"
+    finally:
+        server.close()
+        service.close()
+
+
+def test_history_contract_fastapi(serving_artifact):
+    """Same surface on the FastAPI adapter: catalog, unknown-series 422,
+    HTML dashboard (parity with the asyncio adapter)."""
+    pytest.importorskip("fastapi")
+    from fastapi.testclient import TestClient
+
+    from cobalt_smart_lender_ai_tpu.serve import ScorerService
+    from cobalt_smart_lender_ai_tpu.serve.http_fastapi import create_app
+
+    store, _ = serving_artifact
+    service = ScorerService.from_store(store, _history_cfg())
+    try:
+        service.history.sample_once()  # no lifespan: sample by hand
+        client = TestClient(create_app(service=service))
+        r = client.get("/history")
+        assert r.status_code == 200
+        assert set(r.json()) == {"series", "tiers"}
+        r = client.get("/history", params={"series": "no_such_series"})
+        assert r.status_code == 422
+        assert "unknown series" in r.json()["detail"]
+        r = client.get("/history", params={"series": "x", "window": "abc"})
+        assert r.status_code == 422
+        r = client.get("/dashboard")
+        assert r.status_code == 200
+        assert r.headers["content-type"].startswith("text/html")
+        assert "Latency quantiles" in r.text
+    finally:
+        service.close()
+
+
+def test_render_dashboard_with_samples():
+    clock = FakeClock()
+    state = {"buckets": {0.1: 0.0, math.inf: 0.0}, "count": 0.0}
+
+    def scrape():
+        return _expo(
+            hist={
+                "cobalt_request_latency_seconds": (
+                    state["buckets"],
+                    state["count"],
+                )
+            },
+            gauges={"cobalt_microbatch_queue_depth": 3.0},
+        )
+
+    ts = TimeSeriesStore(scrape=scrape, clock=clock, tiers=((1.0, 32),))
+    for t in (0.0, 1.0, 2.0):
+        clock.t = t
+        state["buckets"] = {0.1: 5.0 * t, math.inf: 5.0 * t}
+        state["count"] = 5.0 * t
+        ts.sample_once()
+    html = render_dashboard(ts)
+    assert "cobalt_request_latency_seconds:p99" in html
+    assert "<svg" in html
+    assert "cobalt_microbatch_queue_depth" in html
+
+
+# --- perf sentinel ------------------------------------------------------------
+
+
+from cobalt_smart_lender_ai_tpu.telemetry import trend as trendlib  # noqa: E402
+
+
+def test_extract_metrics_known_shapes():
+    assert trendlib.extract_metrics(
+        {"metric": "rows_per_sec_per_chip", "value": 123.0}
+    ) == {"rows_per_sec_per_chip": 123.0}
+    # driver wrapper: failed run (rc!=0, parsed null) yields no metrics
+    assert (
+        trendlib.extract_metrics({"cmd": "x", "rc": 1, "parsed": None}) == {}
+    )
+    m = trendlib.extract_metrics(
+        {
+            "bench": "serve_throughput",
+            "results": {"batcher_on": {"qps": 100.0, "p99.9_ms": 9.0}},
+        }
+    )
+    assert m == {"serve.batcher_on.qps": 100.0, "serve.batcher_on.p999_ms": 9.0}
+    m = trendlib.extract_metrics(
+        {
+            "bench": "search_halving_vs_exhaustive",
+            "compile": {"cache_misses": 4},
+            "runs": {"halving": {"dispatch_seconds": 2.5}},
+        }
+    )
+    assert m == {
+        "search.compile.cache_misses": 4.0,
+        "search.halving.warm_dispatch_seconds": 2.5,
+    }
+    assert trendlib.extract_metrics({"totally": "unknown"}) == {}
+
+
+def test_gate_policies():
+    assert trendlib.policy_for("serve.batcher_on.qps")["kind"] == "ratio_min"
+    assert (
+        trendlib.policy_for("serve_async.asyncio.clients_128.p999_ms")["limit"]
+        == 1.5
+    )
+    assert (
+        trendlib.policy_for("search.halving.warm_dispatch_seconds")["limit"]
+        == 1.25
+    )
+    assert trendlib.policy_for("search.compile.cache_misses")["kind"] == (
+        "slack_max"
+    )
+    assert trendlib.policy_for("search.halving.cv_auc") is None
+
+
+def _trend_with(rows):
+    doc = trendlib.new_trend()
+    for metrics in rows:
+        trendlib.append_row(doc, source="test", metrics=metrics)
+    return doc
+
+
+def test_check_rolling_median_baseline():
+    rows = [{"serve.batcher_on.qps": v} for v in (100, 90, 110, 95, 105)]
+    # median of the 5 priors is 100 -> floor is 70
+    ok = trendlib.check(_trend_with(rows + [{"serve.batcher_on.qps": 71.0}]))
+    assert ok["status"] == "pass" and not ok["regressions"]
+    bad = trendlib.check(_trend_with(rows + [{"serve.batcher_on.qps": 69.0}]))
+    assert bad["status"] == "regression"
+    assert bad["regressions"][0]["metric"] == "serve.batcher_on.qps"
+    assert bad["regressions"][0]["baseline"] == 100.0
+
+
+def test_check_missing_baseline_and_empty():
+    assert trendlib.check(trendlib.new_trend())["status"] == "empty"
+    first = trendlib.check(_trend_with([{"serve.batcher_on.qps": 10.0}]))
+    assert first["status"] == "missing_baseline"
+    # tracked-only metrics never gate
+    tracked = trendlib.check(
+        _trend_with([{"cv_auc": 0.9}, {"cv_auc": 0.1}])
+    )
+    assert tracked["status"] == "pass" and not tracked["checked"]
+
+
+def _sentinel(tmp_path, *argv):
+    import os
+
+    return subprocess.run(
+        [sys.executable, "tools/perf_sentinel.py", *argv],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+@pytest.mark.slow
+def test_perf_sentinel_exit_code_matrix(tmp_path):
+    trend_path = str(tmp_path / "TREND.json")
+    record = {
+        "bench": "serve_throughput",
+        "results": {"batcher_on": {"qps": 100.0, "p99.9_ms": 10.0}},
+    }
+    src = tmp_path / "bench.json"
+    src.write_text(json.dumps(record))
+    # first row: gated metrics but nothing to compare against -> 3
+    r = _sentinel(tmp_path, "--trend", trend_path, "ingest", str(src))
+    assert r.returncode == 0, r.stderr
+    assert (
+        _sentinel(tmp_path, "--trend", trend_path, "check").returncode == 3
+    )
+    # steady state -> 0
+    _sentinel(tmp_path, "--trend", trend_path, "ingest", str(src))
+    assert (
+        _sentinel(tmp_path, "--trend", trend_path, "check").returncode == 0
+    )
+    # synthetic regression -> 1
+    record["results"]["batcher_on"] = {"qps": 10.0, "p99.9_ms": 200.0}
+    src.write_text(json.dumps(record))
+    _sentinel(tmp_path, "--trend", trend_path, "ingest", str(src))
+    r = _sentinel(tmp_path, "--trend", trend_path, "check")
+    assert r.returncode == 1
+    report = json.loads(r.stdout)
+    assert {e["metric"] for e in report["regressions"]} == {
+        "serve.batcher_on.qps",
+        "serve.batcher_on.p999_ms",
+    }
+    # render writes an HTML artifact with sparklines
+    out = tmp_path / "trend.html"
+    r = _sentinel(
+        tmp_path, "--trend", trend_path, "render", "--out", str(out)
+    )
+    assert r.returncode == 0
+    assert "<svg" in out.read_text()
+
+
+def test_committed_trend_baseline_passes():
+    """The committed TREND.json must gate clean — perf_sentinel --check
+    exits zero on the repo's own baseline (the CI trend-gate contract)."""
+    doc = trendlib.load_trend("/root/repo/TREND.json")
+    assert len(doc["rows"]) >= 9
+    report = trendlib.check(doc)
+    assert report["status"] in ("pass", "missing_baseline"), report
+    assert not report["regressions"]

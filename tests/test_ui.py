@@ -3,7 +3,6 @@ data, over real HTTP against the stdlib server (no Streamlit needed — the
 render shell is `ui/app.py`; everything it computes lives in `ui/core`)."""
 
 import math
-import threading
 
 import matplotlib
 
@@ -17,7 +16,7 @@ import pytest
 from cobalt_smart_lender_ai_tpu.data import schema
 from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
 from cobalt_smart_lender_ai_tpu.serve import ScorerService
-from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
 from cobalt_smart_lender_ai_tpu.serve.service import validate_single_input
 
 
@@ -47,12 +46,11 @@ def ui_env(tmp_path_factory, engineered):
         bin_spec=model.bin_spec,
         feature_names=tuple(schema.SERVING_FEATURES),
     ).save(store, "models/gbdt/model_tree")
-    httpd = make_server(
+    server = make_async_server(
         ScorerService.from_store(store, _fast_cfg()), "127.0.0.1", 0
     )
-    threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    yield core.ApiClient(f"http://127.0.0.1:{httpd.server_address[1]}")
-    httpd.shutdown()
+    yield core.ApiClient(f"http://127.0.0.1:{server.port}")
+    server.close()
 
 
 def default_form_payload():

@@ -11,7 +11,6 @@ by design (and `test_ui.py` unit-tests that layer directly).
 """
 
 import sys
-import threading
 import types
 
 import numpy as np
@@ -129,16 +128,15 @@ class _Upload:
 
 @pytest.fixture(scope="module")
 def live_server(serving_artifact):
-    from cobalt_smart_lender_ai_tpu.serve.http_stdlib import make_server
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
     from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 
     store, X = serving_artifact
-    server = make_server(
+    server = make_async_server(
         ScorerService.from_store(store, _fast_cfg()), "127.0.0.1", 0
     )
-    threading.Thread(target=server.serve_forever, daemon=True).start()
-    yield f"http://127.0.0.1:{server.server_address[1]}", X
-    server.shutdown()
+    yield f"http://127.0.0.1:{server.port}", X
+    server.close()
 
 
 def _run_app(monkeypatch, url, script):

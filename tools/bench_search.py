@@ -122,6 +122,12 @@ def main(argv=None):
     )
     ap.add_argument("--out", default=None)
     ap.add_argument(
+        "--trend-out",
+        default=None,
+        help="append this run's warm-dispatch/cache-miss metrics to the "
+        "given TREND.json (gate with tools/perf_sentinel.py check)",
+    )
+    ap.add_argument(
         "--check",
         action="store_true",
         help="with --mode both: exit nonzero unless halving pruned "
@@ -267,6 +273,16 @@ def main(argv=None):
                     f"refit AUC gap {out['refit_auc_gap']} exceeds 0.005"
                 )
     out["check_failures"] = failures
+
+    if args.trend_out:
+        import time
+
+        from cobalt_smart_lender_ai_tpu.telemetry.trend import append_record
+
+        append_record(
+            args.trend_out, out, source="tools/bench_search.py",
+            stamp=time.time(),
+        )
 
     blob = json.dumps(out, indent=2, sort_keys=True)
     if args.out:

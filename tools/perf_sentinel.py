@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Perf sentinel: ingest benchmark artifacts into TREND.json and gate
+regressions against the rolling baseline.
+
+    # append artifacts (BENCH_*.json, run ledgers, bench harness records)
+    python tools/perf_sentinel.py ingest BENCH_SERVE_r03.json runs/*.json
+
+    # gate the newest row (CI): exit 0 pass, 1 regression, 3 no baseline
+    python tools/perf_sentinel.py check
+
+    # render the sparkline trend page (CI artifact)
+    python tools/perf_sentinel.py render --out trend.html
+
+All math lives in `cobalt_smart_lender_ai_tpu.telemetry.trend`; this is
+argv plumbing plus exit codes. `check` prints its report as JSON so CI
+logs carry the numbers, not just the verdict. Note argparse itself exits
+2 on bad usage, which stays distinct from the gate codes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cobalt_smart_lender_ai_tpu.telemetry import trend as trendlib
+
+EXIT_PASS = 0
+EXIT_REGRESSION = 1
+EXIT_MISSING_BASELINE = 3
+
+
+def _cmd_ingest(args: argparse.Namespace) -> int:
+    doc = trendlib.load_trend(args.trend)
+    for path in args.files:
+        with open(path) as fh:
+            text = fh.read()
+        # bench.py emits one record per line; tolerate multi-line files too.
+        records = []
+        try:
+            records.append(json.loads(text))
+        except json.JSONDecodeError:
+            for line in text.splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    records.append(json.loads(line))
+        for record in records:
+            row = trendlib.append_row(
+                doc,
+                source=os.path.basename(path),
+                metrics=trendlib.extract_metrics(record),
+                stamp=None if args.no_stamp else time.time(),
+            )
+            print(
+                f"ingested {path}: {len(row['metrics'])} metrics",
+                file=sys.stderr,
+            )
+    trendlib.save_trend(doc, args.trend)
+    return EXIT_PASS
+
+
+def _cmd_check(args: argparse.Namespace) -> int:
+    doc = trendlib.load_trend(args.trend)
+    report = trendlib.check(doc)
+    print(json.dumps(report, indent=1, sort_keys=True))
+    if report["status"] == "regression":
+        return EXIT_REGRESSION
+    if report["status"] in ("missing_baseline", "empty"):
+        return EXIT_MISSING_BASELINE
+    if report["missing"] and args.strict_missing:
+        return EXIT_MISSING_BASELINE
+    return EXIT_PASS
+
+
+def _cmd_render(args: argparse.Namespace) -> int:
+    doc = trendlib.load_trend(args.trend)
+    html = trendlib.render_trend_html(doc)
+    with open(args.out, "w") as fh:
+        fh.write(html)
+    print(f"wrote {args.out}", file=sys.stderr)
+    return EXIT_PASS
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="perf_sentinel", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument(
+        "--trend",
+        default="TREND.json",
+        help="trend ledger path (default: TREND.json)",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_ingest = sub.add_parser(
+        "ingest", help="append benchmark artifacts as trend rows"
+    )
+    p_ingest.add_argument("files", nargs="+")
+    p_ingest.add_argument(
+        "--no-stamp",
+        action="store_true",
+        help="omit stamp_unix (deterministic seeding of committed history)",
+    )
+    p_ingest.set_defaults(fn=_cmd_ingest)
+
+    p_check = sub.add_parser(
+        "check", help="gate the newest row vs the rolling baseline"
+    )
+    p_check.add_argument(
+        "--strict-missing",
+        action="store_true",
+        help="exit 3 when any gated metric lacks a baseline "
+        "(default: warn only if at least one metric was checked)",
+    )
+    p_check.set_defaults(fn=_cmd_check)
+
+    p_render = sub.add_parser("render", help="write the trend HTML page")
+    p_render.add_argument("--out", default="trend.html")
+    p_render.set_defaults(fn=_cmd_render)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
