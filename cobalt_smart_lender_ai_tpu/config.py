@@ -31,6 +31,12 @@ class DataConfig:
     split_seed: int = 22
     null_col_threshold: float = 70.0  # clean_data.py:31 — drop cols >70% missing
     row_null_allowance: int = 20  # feature_engineering.py:66 — drop rows missing >20 cols
+    #: Run L1/L2 as jitted columnar device programs (data/device_pipeline.py)
+    #: instead of the pandas path. Parity between the two is CI-gated.
+    device_pipeline: bool = True
+    #: Row shards for the device-ingest feature-assembly / binning programs:
+    #: 1 = single device, -1 = all visible devices (make_partitioner knob).
+    ingest_shards: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

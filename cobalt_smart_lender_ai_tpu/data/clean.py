@@ -15,6 +15,13 @@ This is intentionally a host-side stage: it is the irreducibly stringy part of
 the pipeline. Everything numeric and O(N) downstream runs on device
 (see `features.py`). Returns a `CleanReport` instead of printing (the reference
 prints `df.info()` to stdout, clean_data.py:107-110).
+
+This module is also the "stringy frontier" of the device-resident ingest path
+(`data/device_pipeline.py`): `tokenize_raw_frame` there calls the parsers
+defined here once per irreducibly-string column, and every one of the eight
+rules above is then replayed as jitted columnar ops over the tokenized
+device matrix. Any semantic change here must keep the two paths in parity
+(gated by `tests/test_device_pipeline.py`).
 """
 
 from __future__ import annotations
@@ -39,16 +46,34 @@ class CleanReport:
 
 
 def parse_percent(series: pd.Series) -> pd.Series:
-    """'13.56%' -> 0.1356 (clean_data.py:125-127, feature_engineering.py:74)."""
+    """'13.56%' -> 0.1356 (clean_data.py:125-127, feature_engineering.py:74).
+
+    Whitespace-only / empty / unparseable cells coerce to NaN instead of
+    raising (real exports carry blank cells in `revol_util` and the
+    hardship columns).
+    """
     if not pd.api.types.is_numeric_dtype(series):
-        series = series.str.replace("%", "", regex=False).astype(float)
+        series = pd.to_numeric(
+            series.str.replace("%", "", regex=False).str.strip(),
+            errors="coerce",
+        )
     return series.astype(float) / 100.0
 
 
 def parse_term(series: pd.Series) -> pd.Series:
-    """' 36 months' -> 36 (clean_data.py:121-123)."""
+    """' 36 months' -> 36 (clean_data.py:121-123).
+
+    Clean all-present input keeps the reference's int dtype; any NaN or
+    unparseable cell (whitespace-only, empty string) degrades the column
+    to float with NaN in that cell rather than raising on `astype`.
+    """
     if not pd.api.types.is_numeric_dtype(series):
-        return series.str.replace(" months", "", regex=False).astype(int)
+        series = pd.to_numeric(
+            series.str.replace(" months", "", regex=False).str.strip(),
+            errors="coerce",
+        )
+    if bool(series.isnull().any()):
+        return series.astype(float)
     return series.astype(int)
 
 

@@ -1,0 +1,945 @@
+"""Device-resident ingest: tokenize once, then jitted columnar clean ->
+features -> binning with no host round-trips between stages.
+
+The pandas path (`clean.py` -> `features.py`) crunches every row on host
+before the first device dispatch — the run-ledger stage wall at the 2.3M-row
+scale. This module splits ingest at the only boundary that is irreducibly
+host-bound, the *stringy frontier*:
+
+- `tokenize_raw_frame` runs exactly one vectorized pandas pass over the
+  irreducibly-string columns (`term`/`int_rate` parses, `revol_util`
+  percent, `emp_length` regex, `earliest_cr_line` date age, and
+  sorted-vocabulary integer codes for every other object column) and emits a
+  single dense `(N, C)` float32 device matrix with NaN as the universal
+  missing marker (categorical codes are small integers, exact in float32).
+- `run_device_ingest` then replays every observable rule of
+  `clean_raw_frame`, `prepare_cleaned_frame` and `engineer_features` as
+  jitted columnar programs over that matrix: null-count stats, the
+  near-complete row drop, the hardship/zero fills, keep-first dedupe (hashed
+  on canonicalized float32 bit patterns), the row-null threshold, label
+  mapping, log1p / one-hot / impute+indicator feature assembly, and the
+  quantile-bin GBDT sketch (`ops/binning.py`) — fused so features flow into
+  the sketch without leaving the device. Only (F,)-sized stats and row
+  counts are fetched; they drive host-side *column bookkeeping* (which
+  names are live, in what order), never row work.
+
+Every program is compiled through `Partitioner.compile_rowwise`
+(`parallel/partitioner.py`), registered in the ProgramRegistry under
+``ingest.*`` names, and timed into the ``cobalt_ingest_dispatch_seconds``
+family — one wall measurement feeds both the program table and the measured
+family, so RunLedger attribution covers ingest by construction. The
+row-wise programs (feature assembly, bin transform) shard over the ``dp``
+mesh via the existing partition rules; stats/compaction programs run
+exact-N on a single device because their reductions (quantiles, medians,
+dedupe) are not shard-decomposable.
+
+Parity contract (gated by `tests/test_device_pipeline.py` and the CI
+ingest-smoke job): the device path's tree/nn matrices match the pandas path
+bit-identically for integer, categorical, one-hot and indicator columns,
+and within float32 tolerance for derived floats (log1p, percent parses,
+medians) — in practice bit-identical there too, because both paths trace
+the *same code objects* from `features.py`. Known resolution caveats,
+irrelevant for well-formed exports: dedupe equality is decided at float32
+resolution on a salted 64-bit row hash (pandas compares float64/strings);
+degenerate string cells (whitespace-only) become NaN at tokenize time, so
+the near-complete row-drop stats see them as missing one rule earlier than
+pandas does; and a column carrying two distinct missing reprs (both
+``None`` and ``float('nan')``) collapses to one label-encode token.
+
+`transform_raw_rows` exposes the same jitted assembly to `serve/service.py`
+as the raw-row scoring path: one raw payload goes through the identical
+tokenize -> log1p -> one-hot programs using the `FeaturePlan` vocabularies,
+killing train/serve feature skew by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from datetime import datetime
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.data.clean import (
+    CleanReport,
+    parse_percent,
+    parse_term,
+)
+from cobalt_smart_lender_ai_tpu.data.features import (
+    FeatureFrame,
+    FeaturePlan,
+    impute_with_indicators,
+    log1p_masked,
+    one_hot_codes,
+)
+from cobalt_smart_lender_ai_tpu.data.split import _mix_u32, keep_order
+from cobalt_smart_lender_ai_tpu.ops.binning import (
+    BinSpec,
+    bin_edges_and_transform,
+    compute_bin_edges,
+)
+from cobalt_smart_lender_ai_tpu.ops import binning as binning_ops
+from cobalt_smart_lender_ai_tpu.parallel.partitioner import (
+    Partitioner,
+    SingleDevicePartitioner,
+)
+from cobalt_smart_lender_ai_tpu.telemetry.metrics import (
+    default_registry,
+    log_buckets,
+)
+
+__all__ = [
+    "DeviceIngestResult",
+    "TokenizedFrame",
+    "run_device_ingest",
+    "tokenize_raw_frame",
+    "transform_raw_rows",
+]
+
+# Measured dispatch-seconds family for the attribution denominator
+# (`telemetry/runledger.py` lists it in _DISPATCH_SECONDS_FAMILIES). Timed
+# tightly around each compiled dispatch by `compile_rowwise`'s observer hook,
+# with the same measurement recorded on the program handle, so the ingest
+# contribution to the attribution ratio is ~1.0.
+_INGEST_DISPATCH_S = default_registry().histogram(
+    "cobalt_ingest_dispatch_seconds",
+    "wall time of one device-ingest program dispatch",
+    buckets=log_buckets(1e-5, 120.0, per_decade=3),
+)
+_INGEST_ROWS = default_registry().counter(
+    "cobalt_ingest_rows_total",
+    "raw rows entering the device-resident ingest flow",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenizedFrame:
+    """Output of the stringy frontier: one dense device matrix plus the host
+    bookkeeping needed to replay the pandas column semantics.
+
+    ``X`` is ``(N, C)`` float32 with NaN for missing everywhere; columns are
+    in raw-frame order (minus the ``Unnamed:`` artifacts). ``kinds[i]`` is
+    ``"numeric"`` (parsed or passthrough) or ``"categorical"`` (sorted-vocab
+    codes). ``vocab`` / ``missing_token`` are per *physical column index*.
+    """
+
+    columns: tuple[str, ...]
+    X: jax.Array
+    kinds: tuple[str, ...]
+    vocab: Mapping[int, tuple[str, ...]]
+    missing_token: Mapping[int, tuple[str, ...]]
+    today: datetime
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.X.shape[0])
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceIngestResult:
+    """Everything `pipeline.py` needs from the engineer stage, plus the
+    fused GBDT sketch: the quantile edges and binned matrix come out of the
+    same device flow as the features (tentpole (c))."""
+
+    tree: FeatureFrame
+    nn: FeatureFrame
+    plan: FeaturePlan
+    bin_spec: BinSpec
+    bins: jax.Array  # (N, F_tree) uint8/int32 bin indices
+    report: CleanReport
+    #: Post-clean frame (``keep_cleaned=True`` only), decoded back to pandas
+    #: for the `cleaned_key` intermediate artifact. Categorical codes decode
+    #: to their vocabulary strings; frontier-parsed columns (term, percents,
+    #: emp_length, dates) stay in their tokenized numeric form rather than
+    #: the raw string spelling the pandas path preserves.
+    cleaned: pd.DataFrame | None = None
+
+
+# --- Stringy frontier ---------------------------------------------------------
+
+
+def _emp_length_numeric(series: pd.Series) -> pd.Series:
+    """Exactly `prepare_cleaned_frame`'s emp_length transform."""
+    emp = series.replace("< 1 year", "0")
+    return pd.to_numeric(emp.str.extract(r"(\d+)")[0], errors="coerce")
+
+
+def _date_age_days(series: pd.Series, today: datetime) -> pd.Series:
+    dates = pd.to_datetime(series, format="%b-%Y", errors="coerce")
+    return (today - dates).dt.days
+
+
+def tokenize_raw_frame(
+    df: pd.DataFrame, *, today: datetime | None = None
+) -> TokenizedFrame:
+    """Host frontier: one vectorized pass per irreducibly-string column.
+
+    Numeric columns pass through as float32. The frontier parse columns
+    (`schema.FRONTIER_*`) get the same parse the pandas path applies later
+    (clean rule 4 / prepare) — pulling the parse forward is safe because
+    every parse is injective and NaN-preserving, so the clean-stage null
+    stats and dedupe see an equivalent matrix. `loan_status` stays
+    categorical (its label map is *not* injective; it is applied on device
+    at the prepare step so dedupe still distinguishes statuses).
+    """
+    now = today or datetime.today()
+    df = df.drop(columns=list(schema.UNNAMED_COLS), errors="ignore")
+    cols: list[np.ndarray] = []
+    names: list[str] = []
+    kinds: list[str] = []
+    vocab: dict[int, tuple[str, ...]] = {}
+    missing_token: dict[int, tuple[str, ...]] = {}
+    for name in df.columns:
+        series = df[name]
+        numeric = pd.api.types.is_numeric_dtype(series)
+        if name in schema.FRONTIER_TERM_COLS:
+            out = parse_term(series).astype(np.float64)
+        elif name in schema.FRONTIER_PERCENT_COLS:
+            # int_rate parses unconditionally (clean rule 4 divides numeric
+            # input by 100 too); revol_util only when stringy (prepare
+            # leaves an already-numeric column untouched).
+            if name == "revol_util" and numeric:
+                out = series.astype(np.float64)
+            else:
+                out = parse_percent(series)
+        elif name in schema.FRONTIER_EMP_COLS and not numeric:
+            out = _emp_length_numeric(series)
+        elif name in schema.FRONTIER_DATE_COLS:
+            out = _date_age_days(series, now)
+        elif numeric:
+            out = series
+        else:
+            idx = len(names)
+            null = series.isnull()
+            cats = sorted(series.dropna().astype(str).unique())
+            if (
+                name == "hardship_status"
+                and bool(null.any())
+                and schema.HARDSHIP_FILL not in cats
+            ):
+                # Clean rule 3 will fill NaN with this token on device; the
+                # pandas path's vocabulary therefore contains it whenever
+                # the raw column had nulls.
+                cats = sorted(cats + [schema.HARDSHIP_FILL])
+            vocab[idx] = tuple(cats)
+            missing_token[idx] = tuple(
+                sorted(series[null].astype(str).unique())
+            )
+            lookup = {v: i for i, v in enumerate(cats)}
+            codes = series.astype(str).map(lookup)
+            codes = codes.where(~null, np.nan)
+            names.append(name)
+            kinds.append("categorical")
+            cols.append(codes.to_numpy(np.float32))
+            continue
+        names.append(name)
+        kinds.append("numeric")
+        cols.append(np.asarray(out, dtype=np.float64).astype(np.float32))
+    if cols:
+        X = np.stack(cols, axis=1)
+    else:
+        X = np.zeros((len(df), 0), np.float32)
+    return TokenizedFrame(
+        columns=tuple(names),
+        X=jnp.asarray(X),
+        kinds=tuple(kinds),
+        vocab=vocab,
+        missing_token=missing_token,
+        today=now,
+    )
+
+
+# --- Jitted program bodies ----------------------------------------------------
+# Each takes (consts, X); consts leaves are arrays (their shapes are static
+# at trace time, which is how loop bounds and output widths stay static
+# without closures). Structural statics that do need closures are produced
+# by `_make_*` factories and named in the exec-cache `static_key`.
+
+
+def _null_counts(consts, X):
+    del consts
+    return jnp.sum(jnp.isnan(X), axis=0)
+
+
+def _compact_by_nonnull(consts, X):
+    """Keep rows with >= thresh non-null cells among the selected columns;
+    kept rows first in original order (device analog of `dropna`)."""
+    sel, thresh = consts
+    sub = jnp.take(X, sel, axis=1)
+    keep = jnp.sum(~jnp.isnan(sub), axis=1) >= thresh
+    return jnp.take(X, keep_order(keep), axis=0), jnp.sum(keep)
+
+
+def _fill_cols(consts, X):
+    sel, vals = consts
+    cols = jnp.take(X, sel, axis=1)
+    return X.at[:, sel].set(jnp.where(jnp.isnan(cols), vals[None, :], cols))
+
+
+def _dedupe_keep_first(consts, X):
+    """`drop_duplicates()` on device: canonicalize each cell's float32 bit
+    pattern (one NaN, +0.0), salt-mix per column into a 64-bit (two-lane)
+    row hash, stable-lexsort, and drop every row whose hash equals its
+    sorted predecessor — keep='first' because lexsort preserves original
+    order within equal keys. NaN == NaN, as in pandas."""
+    (sel,) = consts
+    sub = jnp.take(X, sel, axis=1)
+    sub = jnp.where(jnp.isnan(sub), jnp.float32(jnp.nan), sub + 0.0)
+    bits = jax.lax.bitcast_convert_type(sub, jnp.uint32)
+    salts = (
+        jnp.arange(bits.shape[1], dtype=jnp.uint32) * jnp.uint32(0x9E3779B9)
+    )
+    h1 = _mix_u32(jnp.sum(_mix_u32(bits ^ salts[None, :], 101),
+                          axis=1, dtype=jnp.uint32), 103)
+    h2 = _mix_u32(jnp.sum(_mix_u32(bits ^ ~salts[None, :], 107),
+                          axis=1, dtype=jnp.uint32), 109)
+    # Primary h1, then h2, then original index: the index tiebreak pins the
+    # first occurrence to the front of each equal-hash run (keep='first').
+    order = jnp.lexsort((jnp.arange(h1.shape[0]), h2, h1))
+    s1, s2 = h1[order], h2[order]
+    dup_sorted = jnp.concatenate(
+        [jnp.zeros((1,), bool), (s1[1:] == s1[:-1]) & (s2[1:] == s2[:-1])]
+    )
+    keep = jnp.zeros_like(dup_sorted).at[order].set(~dup_sorted)
+    return jnp.take(X, keep_order(keep), axis=0), jnp.sum(keep)
+
+
+def _vocab_census(consts, X):
+    """Per categorical column: which codes survive the row drops, and does
+    any NaN survive. Drives the host-side rebuild of the engineer-stage
+    vocabularies (pandas discovers them *after* clean/prepare drops)."""
+    sel, vm = consts  # vm = arange(vmax), sized by the largest vocabulary
+    vmax = vm.shape[0]
+    present_rows = []
+    nan_rows = []
+    for i in range(sel.shape[0]):
+        col = X[:, sel[i]]
+        code = jnp.where(jnp.isnan(col), vmax, col).astype(jnp.int32)
+        present = jnp.zeros((vmax + 1,), jnp.bool_).at[code].set(True)
+        present_rows.append(present[:vmax])
+        nan_rows.append(present[vmax])
+    return jnp.stack(present_rows), jnp.stack(nan_rows)
+
+
+def _numeric_prep(Xn, res_pos, res_starts, res_miss, res_flat, log_mask):
+    """Residual label-encode (recode full-tokenize codes to the surviving
+    vocabulary, missing -> its astype(str) token's code) then masked log1p —
+    the exact op order of `engineer_features` before any stats."""
+    for j in range(res_pos.shape[0]):
+        col = Xn[:, res_pos[j]]
+        code = jnp.where(jnp.isnan(col), 0, col).astype(jnp.int32)
+        new = jnp.where(
+            jnp.isnan(col), res_miss[j], res_flat[res_starts[j] + code]
+        )
+        Xn = Xn.at[:, res_pos[j]].set(new)
+    return log1p_masked(Xn, log_mask)
+
+
+def _engineer_stats(consts, X):
+    num_idx, log_mask, res_pos, res_starts, res_miss, res_flat = consts
+    Xn = _numeric_prep(
+        jnp.take(X, num_idx, axis=1),
+        res_pos, res_starts, res_miss, res_flat, log_mask,
+    )
+    nan_any = jnp.any(jnp.isnan(Xn), axis=0)
+    med = jnp.nanmedian(Xn, axis=0)
+    return nan_any, jnp.where(jnp.isnan(med), 0.0, med)
+
+
+def _make_assemble_fn(
+    n_classes: tuple[int, ...],
+    inc_pos: int,
+    dti_pos: int,
+    has_label: bool,
+) -> Callable[[Any, jax.Array], Any]:
+    """Row-wise fused feature assembly: (N, C) tokenized matrix ->
+    (X_tree, X_nn[, y]). Shardable over the dp mesh — every output row
+    depends only on its input row. Traces the same `features.py` code
+    objects (`log1p_masked`, `one_hot_codes`, `impute_with_indicators`) the
+    pandas path dispatches, so the matrices cannot drift."""
+
+    def assemble(consts, X):
+        (num_idx, log_mask, res_pos, res_starts, res_miss, res_flat,
+         medians, need, ind_idx, cat_idx, cat_starts, cat_flat,
+         label_pos, label_table) = consts
+        Xn = _numeric_prep(
+            jnp.take(X, num_idx, axis=1),
+            res_pos, res_starts, res_miss, res_flat, log_mask,
+        )
+        new_codes = []
+        for i in range(len(n_classes)):
+            col = X[:, cat_idx[i]]
+            old = jnp.where(jnp.isnan(col), 0, col).astype(jnp.int32)
+            new_codes.append(
+                jnp.where(jnp.isnan(col), -1.0, cat_flat[cat_starts[i] + old])
+            )
+        tree_blocks = [Xn]
+        for i, k in enumerate(n_classes):
+            if k > 1:
+                tree_blocks.append(
+                    one_hot_codes(new_codes[i].astype(jnp.int32), k)
+                )
+        X_tree = jnp.concatenate(tree_blocks, axis=1)
+
+        filled, indicators = impute_with_indicators(Xn, medians, need)
+        nn_blocks = [filled]
+        if ind_idx.shape[0]:
+            nn_blocks.append(jnp.take(indicators, ind_idx, axis=1))
+        if inc_pos >= 0:
+            inc = Xn[:, inc_pos]
+            nn_blocks.append(
+                ((jnp.isnan(inc)) | (inc == 0)).astype(jnp.float32)[:, None]
+            )
+        if dti_pos >= 0:
+            nn_blocks.append(
+                jnp.isnan(Xn[:, dti_pos]).astype(jnp.float32)[:, None]
+            )
+        for i, k in enumerate(n_classes):
+            code = new_codes[i]
+            nn_blocks.append(
+                jnp.where(code < 0, jnp.float32(k), code)[:, None]
+            )
+        X_nn = jnp.concatenate(nn_blocks, axis=1)
+        if not has_label:
+            return X_tree, X_nn
+        lcol = X[:, label_pos[0]]
+        lcode = jnp.where(jnp.isnan(lcol), 0, lcol).astype(jnp.int32)
+        y = jnp.where(jnp.isnan(lcol), jnp.float32(jnp.nan),
+                      label_table[lcode])
+        return X_tree, X_nn, y
+
+    return assemble
+
+
+def _make_raw_row_fn(
+    n_classes: tuple[int, ...], n_num: int
+) -> Callable[[Any, jax.Array], jax.Array]:
+    """Serving transform: [numeric | cat codes] -> tree-feature row(s),
+    tracing the same log1p/one-hot code as `_make_assemble_fn`."""
+
+    def raw(consts, X):
+        (log_mask,) = consts
+        blocks = [log1p_masked(X[:, :n_num], log_mask)]
+        for i, k in enumerate(n_classes):
+            col = X[:, n_num + i]
+            codes = jnp.where(jnp.isnan(col), -1, col).astype(jnp.int32)
+            if k > 1:
+                blocks.append(one_hot_codes(codes, k))
+        return jnp.concatenate(blocks, axis=1)
+
+    return raw
+
+
+# --- Device ingest flow -------------------------------------------------------
+
+
+def _run_program(part, fn, consts, X, kind, static_key=()):
+    call = part.compile_rowwise(
+        fn,
+        consts,
+        int(X.shape[0]),
+        int(X.shape[1]),
+        kind=kind,
+        static_key=static_key,
+        observe=_INGEST_DISPATCH_S.observe,
+    )
+    return call(X)
+
+
+def _compact(part, fn, consts, X, kind):
+    """Run a (compacted_X, kept_count) program, fetch only the scalar, and
+    slice the kept prefix on device."""
+    out, n = _run_program(part, fn, consts, X, kind)
+    return out[: int(n)], int(n)
+
+
+def _pad_rows(X: jax.Array, multiple: int) -> jax.Array:
+    pad = (-int(X.shape[0])) % multiple
+    if pad:
+        X = jnp.concatenate(
+            [X, jnp.full((pad, X.shape[1]), jnp.nan, X.dtype)], axis=0
+        )
+    return X
+
+
+def run_device_ingest(
+    tok: TokenizedFrame,
+    *,
+    partitioner: Partitioner | None = None,
+    n_bins: int = 255,
+    null_col_threshold: float = 70.0,
+    row_drop_null_limit: int = 10,
+    row_null_allowance: int = 20,
+    unnecessary_cols: Sequence[str] = schema.CLEAN_UNNECESSARY_COLS,
+    fill_zero_cols: Sequence[str] = schema.FILL_ZERO_COLS,
+    one_hot_cols: Sequence[str] = schema.ONE_HOT_COLS,
+    log_cols: Sequence[str] = schema.LOG_COLS,
+    keep_cleaned: bool = False,
+) -> DeviceIngestResult:
+    """Replay clean -> prepare -> engineer -> binning as ``ingest.*``
+    programs over the tokenized matrix. ``partitioner`` shards the row-wise
+    programs (feature assembly, bin transform); stats and compactions run
+    exact-N on a single device regardless."""
+    part = partitioner or SingleDevicePartitioner(kind_prefix="ingest")
+    stats_part = SingleDevicePartitioner(kind_prefix="ingest")
+    _INGEST_ROWS.inc(tok.n_rows)
+
+    pos = {name: i for i, name in enumerate(tok.columns)}
+    live = list(tok.columns)
+    X = tok.X
+    report = CleanReport(n_rows_in=tok.n_rows)
+
+    def sel(names: Sequence[str]) -> np.ndarray:
+        return np.asarray([pos[n] for n in names], dtype=np.int32)
+
+    # Clean rule 2: drop rows missing a value in any near-complete column.
+    counts = np.asarray(_run_program(stats_part, _null_counts, (), X, "null_stats"))
+    near = [n for n in live if counts[pos[n]] < row_drop_null_limit]
+    before = int(X.shape[0])
+    X, n = _compact(
+        stats_part,
+        _compact_by_nonnull,
+        (sel(near), np.int32(len(near))),
+        X,
+        "row_compact",
+    )
+    report.n_rows_dropped_near_complete = before - n
+
+    # Clean rule 3: hardship fill (vocabulary code of the fill token).
+    if "hardship_status" in live:
+        i = pos["hardship_status"]
+        cats = tok.vocab.get(i, ())
+        if schema.HARDSHIP_FILL in cats:
+            X = _run_program(
+                stats_part,
+                _fill_cols,
+                (
+                    sel(["hardship_status"]),
+                    np.asarray([cats.index(schema.HARDSHIP_FILL)], np.float32),
+                ),
+                X,
+                "fill",
+            )
+
+    # Clean rule 4 (term/int_rate parse) happened at tokenize time.
+    # Clean rule 5: missingness-threshold column drop.
+    counts = np.asarray(_run_program(stats_part, _null_counts, (), X, "null_stats"))
+    n_rows = int(X.shape[0])
+    too_null = [
+        c for c in live
+        if n_rows and 100.0 * counts[pos[c]] / n_rows > null_col_threshold
+    ]
+    report.dropped_null_columns = too_null
+    live = [c for c in live if c not in set(too_null)]
+
+    # Clean rule 6: fixed unnecessary-column drop.
+    present_fixed = [c for c in unnecessary_cols if c in live]
+    report.dropped_fixed_columns = present_fixed
+    live = [c for c in live if c not in set(present_fixed)]
+
+    # Clean rule 7: missing-means-zero fills.
+    zero_cols = [c for c in fill_zero_cols if c in live]
+    if zero_cols:
+        X = _run_program(
+            stats_part,
+            _fill_cols,
+            (sel(zero_cols), np.zeros(len(zero_cols), np.float32)),
+            X,
+            "fill",
+        )
+
+    # Clean rule 8: keep-first dedupe over the live columns.
+    before = int(X.shape[0])
+    if before:
+        X, n = _compact(
+            stats_part, _dedupe_keep_first, (sel(live),), X, "dedupe"
+        )
+        report.n_duplicates_removed = before - n
+    report.n_rows_out = int(X.shape[0])
+
+    # Optional host materialization of the clean-stage output, for the
+    # save_intermediate artifact contract. One device->host fetch; costs
+    # nothing when the caller doesn't ask for it.
+    cleaned: pd.DataFrame | None = None
+    if keep_cleaned:
+        Xc = np.asarray(X)
+        data: dict[str, np.ndarray] = {}
+        for c in live:
+            i = pos[c]
+            col = Xc[:, i]
+            if tok.kinds[i] == "categorical" and tok.vocab.get(i):
+                cats = np.asarray(tok.vocab[i], dtype=object)
+                vals = np.full(col.shape[0], np.nan, dtype=object)
+                ok = ~np.isnan(col)
+                vals[ok] = cats[col[ok].astype(np.int64)]
+                data[c] = vals
+            else:
+                data[c] = col.astype(np.float64)
+        cleaned = pd.DataFrame(data)
+
+    # Prepare: leakage/useless drop, then the row-null threshold.
+    fe_drop = set(schema.FE_LEAKAGE_COLS) | set(schema.FE_USELESS_COLS)
+    live = [c for c in live if c not in fe_drop]
+    thresh = max(len(live) - row_null_allowance, 0)
+    X, _ = _compact(
+        stats_part,
+        _compact_by_nonnull,
+        (sel(live), np.int32(thresh)),
+        X,
+        "row_compact",
+    )
+
+    # Prepare renames (value transforms already tokenized; the pandas path
+    # appends each derived column at the end and drops the source).
+    def _rename_to_tail(old: str, new: str) -> None:
+        if old in live:
+            pos[new] = pos[old]
+            live.remove(old)
+            live.append(new)
+
+    _rename_to_tail("emp_length", "emp_length_num")
+    _rename_to_tail("earliest_cr_line", "earliest_cr_line_days")
+    has_label = "loan_status" in live
+    label_pos = pos.get("loan_status", 0)
+    if has_label:
+        live.remove("loan_status")
+
+    # Engineer bookkeeping: numeric order, categorical split.
+    cat_present = [c for c in one_hot_cols if c in live]
+    numeric_names = [c for c in live if c not in set(cat_present)]
+    residual = [
+        c for c in numeric_names if tok.kinds[pos[c]] == "categorical"
+    ]
+
+    # Surviving vocabularies (pandas discovers them post-drops).
+    cat_all = cat_present + residual
+    vocab_surv: dict[str, tuple[str, ...]] = {}
+    nan_surv: dict[str, bool] = {}
+    if cat_all:
+        vmax = max(1, max(len(tok.vocab.get(pos[c], ())) for c in cat_all))
+        present, has_nan = _run_program(
+            stats_part,
+            _vocab_census,
+            (sel(cat_all), np.arange(vmax, dtype=np.int32)),
+            X,
+            "vocab_census",
+        )
+        present = np.asarray(present)
+        has_nan = np.asarray(has_nan)
+        for i, c in enumerate(cat_all):
+            full = tok.vocab.get(pos[c], ())
+            vocab_surv[c] = tuple(
+                v for j, v in enumerate(full) if present[i, j]
+            )
+            nan_surv[c] = bool(has_nan[i])
+
+    # Residual label-encode tables: recode full-tokenize codes to the
+    # sorted astype(str) vocabulary (missing repr included iff missing
+    # cells survived), exactly engineer_features' residual handling.
+    label_vocab: dict[str, tuple[str, ...]] = {}
+    res_pos_l, res_starts_l, res_miss_l, res_flat_l = [], [], [], []
+    for c in residual:
+        full = tok.vocab.get(pos[c], ())
+        toks = tok.missing_token.get(pos[c], ()) or ("nan",)
+        surv = vocab_surv.get(c, ())
+        vocab2 = sorted(set(surv) | (set(toks) if nan_surv.get(c) else set()))
+        label_vocab[c] = tuple(vocab2)
+        lookup = {v: i for i, v in enumerate(vocab2)}
+        table = np.asarray(
+            [float(lookup.get(v, 0)) for v in full] or [0.0], np.float32
+        )
+        res_pos_l.append(numeric_names.index(c))
+        res_starts_l.append(sum(len(t) for t in res_flat_l))
+        res_miss_l.append(float(lookup.get(toks[0], 0)))
+        res_flat_l.append(table)
+    res_consts = (
+        np.asarray(res_pos_l, np.int32),
+        np.asarray(res_starts_l, np.int32),
+        np.asarray(res_miss_l, np.float32),
+        (np.concatenate(res_flat_l) if res_flat_l
+         else np.zeros(1, np.float32)),
+    )
+
+    # One-hot recode tables: full-tokenize code -> surviving sorted code.
+    cat_vocab: dict[str, tuple[str, ...]] = {}
+    cat_starts_l, cat_flat_l, n_classes_l = [], [], []
+    for c in cat_present:
+        full = tok.vocab.get(pos[c], ())
+        cats = vocab_surv.get(c, ())
+        cat_vocab[c] = cats
+        lookup = {v: i for i, v in enumerate(cats)}
+        table = np.asarray(
+            [float(lookup.get(v, -1)) for v in full] or [-1.0], np.float32
+        )
+        cat_starts_l.append(sum(len(t) for t in cat_flat_l))
+        cat_flat_l.append(table)
+        n_classes_l.append(len(cats))
+    cat_consts = (
+        sel(cat_present) if cat_present else np.zeros(0, np.int32),
+        np.asarray(cat_starts_l, np.int32),
+        (np.concatenate(cat_flat_l) if cat_flat_l
+         else np.zeros(1, np.float32)),
+    )
+
+    # Label map table over the *full* tokenize vocabulary (no recode needed;
+    # unseen statuses map to NaN like pandas .map).
+    lab_full = tok.vocab.get(label_pos, ()) if has_label else ()
+    label_table = np.asarray(
+        [float(schema.LOAN_STATUS_MAP.get(v, np.nan)) for v in lab_full]
+        or [np.nan],
+        np.float32,
+    )
+
+    num_idx = sel(numeric_names)
+    log_mask = np.isin(np.asarray(numeric_names), np.asarray(log_cols))
+    stats_consts = (num_idx, log_mask) + res_consts
+    nan_any, medians = _run_program(
+        stats_part, _engineer_stats, stats_consts, X, "stats"
+    )
+    nan_any = np.asarray(nan_any)
+    medians_np = np.asarray(medians)
+
+    dti_pos = numeric_names.index("dti") if "dti" in numeric_names else -1
+    inc_pos = (
+        numeric_names.index("annual_inc")
+        if "annual_inc" in numeric_names else -1
+    )
+    need_ind = nan_any.copy()
+    if dti_pos >= 0:
+        need_ind[dti_pos] = False
+    ind_idx = np.flatnonzero(need_ind).astype(np.int32)
+
+    # Fused row-wise feature assembly, sharded when a mesh is configured.
+    n_classes = tuple(n_classes_l)
+    assemble = _make_assemble_fn(n_classes, inc_pos, dti_pos, has_label)
+    assemble_consts = (
+        num_idx,
+        log_mask,
+        *res_consts,
+        medians_np,
+        need_ind,
+        ind_idx,
+        *cat_consts,
+        np.asarray([label_pos], np.int32),
+        label_table,
+    )
+    n_real = int(X.shape[0])
+    Xp = _pad_rows(X, part.shard_multiple)
+    out = _run_program(
+        part,
+        assemble,
+        assemble_consts,
+        Xp,
+        "assemble",
+        static_key=(n_classes, inc_pos, dti_pos, has_label),
+    )
+    if has_label:
+        X_tree, X_nn, y = out
+        y = y[:n_real]
+    else:
+        X_tree, X_nn = out
+        y = None
+    X_tree = X_tree[:n_real]
+    X_nn = X_nn[:n_real]
+
+    # Fused GBDT sketch: features -> quantile edges -> binned matrix without
+    # leaving the device. Single-device runs use the one-program fused form;
+    # mesh runs compute the (non-shardable) edges exact-N and shard the
+    # row-wise transform.
+    if part.n_shards == 1:
+        spec, bins = _run_program(
+            part,
+            lambda consts, Xt: bin_edges_and_transform(Xt, n_bins=n_bins),
+            (),
+            X_tree,
+            "binning",
+            static_key=(n_bins,),
+        )
+    else:
+        # Quantile edges reduce over all rows (not shard-decomposable), so
+        # they run exact-N on the stats device; the dispatch wrapper gathers
+        # the mesh-sharded feature matrix to that placement.
+        spec = _run_program(
+            stats_part,
+            lambda consts, Xt: compute_bin_edges(Xt, n_bins=n_bins),
+            (),
+            X_tree,
+            "sketch",
+            static_key=(n_bins,),
+        )
+        Xtp = _pad_rows(X_tree, part.shard_multiple)
+        bins = _run_program(
+            part,
+            lambda spec_c, Xt: binning_ops.transform(spec_c, Xt),
+            spec,
+            Xtp,
+            "bin_transform",
+            static_key=(n_bins,),
+        )[:n_real]
+
+    # Names and the replay plan (identical construction to features.py).
+    tree_names = list(numeric_names)
+    for c in cat_present:
+        cats = cat_vocab[c]
+        if len(cats) > 1:
+            tree_names.extend(f"{c}_{v}" for v in cats[1:])
+    nn_names = list(numeric_names)
+    nn_names.extend(f"{numeric_names[i]}_NA" for i in ind_idx)
+    if inc_pos >= 0:
+        nn_names.append("no_income")
+    if dti_pos >= 0:
+        nn_names.append("dti_NA")
+    nn_names.extend(cat_present)
+
+    plan = FeaturePlan(
+        numeric_names=tuple(numeric_names),
+        categorical_vocab=cat_vocab,
+        label_vocab=label_vocab,
+        medians={
+            name: float(medians_np[i])
+            for i, name in enumerate(numeric_names)
+        },
+        log_cols=tuple(c for c in log_cols if c in set(numeric_names)),
+        tree_feature_names=tuple(tree_names),
+        nn_feature_names=tuple(nn_names),
+        asof=tok.today.strftime("%Y-%m-%d"),
+    )
+    return DeviceIngestResult(
+        tree=FeatureFrame(tuple(tree_names), X_tree, y),
+        nn=FeatureFrame(tuple(nn_names), X_nn, y),
+        plan=plan,
+        bin_spec=spec,
+        bins=bins,
+        report=report,
+        cleaned=cleaned,
+    )
+
+
+# --- Raw-row serving path -----------------------------------------------------
+
+
+def _scalar_missing(v: Any) -> bool:
+    if v is None:
+        return True
+    if isinstance(v, float) and np.isnan(v):
+        return True
+    if isinstance(v, str) and not v.strip():
+        return True
+    return False
+
+
+def _scalar_number(v: Any) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return float("nan")
+
+
+def _tokenize_raw_value(name: str, v: Any, today: datetime) -> float:
+    """One cell of the serving frontier — same parses as the batch frontier,
+    plus the clean-stage missing-means-zero fill."""
+    if _scalar_missing(v):
+        return 0.0 if name in schema.FILL_ZERO_COLS else float("nan")
+    if name == "emp_length_num" and isinstance(v, str):
+        s = "0" if v == "< 1 year" else v
+        m = pd.Series([s]).str.extract(r"(\d+)")[0][0]
+        return _scalar_number(m)
+    if name == "earliest_cr_line_days" and isinstance(v, str):
+        d = pd.to_datetime(v, format="%b-%Y", errors="coerce")
+        return float("nan") if pd.isnull(d) else float((today - d).days)
+    if isinstance(v, str):
+        s = v.strip()
+        if name in schema.FRONTIER_TERM_COLS:
+            s = s.replace("months", "").strip()
+            return _scalar_number(s)
+        if name in schema.FRONTIER_PERCENT_COLS or s.endswith("%"):
+            return _scalar_number(s.replace("%", "")) / 100.0
+        return _scalar_number(s)
+    if name == "int_rate":
+        # Mirror parse_percent's numeric branch (clean rule 4).
+        return _scalar_number(v) / 100.0
+    return _scalar_number(v)
+
+
+#: raw payload keys accepted for the prepare-stage derived columns.
+_RAW_ALIASES = {
+    "emp_length_num": ("emp_length_num", "emp_length"),
+    "earliest_cr_line_days": ("earliest_cr_line_days", "earliest_cr_line"),
+}
+
+
+def transform_raw_rows(
+    plan: FeaturePlan,
+    rows: Sequence[Mapping[str, Any]],
+    *,
+    today: datetime | None = None,
+) -> np.ndarray:
+    """Raw payload dict(s) -> ``(n, len(plan.tree_feature_names))`` float32
+    matrix via the same jitted log1p/one-hot programs the batch ingest uses
+    — the serve-side half of the skew-free contract. Missing/unknown values
+    follow the training-time semantics: NaN for the NaN-aware GBDT, -1
+    codes (all-zero one-hot rows) for unseen categories, the hardship fill
+    and missing-means-zero fills applied as in clean. Date -> age features
+    are computed against the plan's ``asof`` snapshot date (falling back to
+    the wall clock only for legacy plans that never recorded one), so the
+    same raw row scores identically regardless of request time."""
+    if today is not None:
+        now = today
+    elif plan.asof:
+        now = datetime.strptime(plan.asof, "%Y-%m-%d")
+    else:
+        now = datetime.today()
+    numeric_names = tuple(plan.numeric_names)
+    cat_names = tuple(plan.categorical_vocab)
+    n_num = len(numeric_names)
+    mat = np.full((len(rows), n_num + len(cat_names)), np.nan, np.float32)
+    for r, payload in enumerate(rows):
+        for j, name in enumerate(numeric_names):
+            v = None
+            for key in _RAW_ALIASES.get(name, (name,)):
+                if key in payload:
+                    v = payload[key]
+                    break
+            if name in plan.label_vocab:
+                vocab2 = plan.label_vocab[name]
+                tok = (
+                    str(v) if not _scalar_missing(v)
+                    else ("nan" if "nan" in vocab2 else "None")
+                )
+                mat[r, j] = (
+                    vocab2.index(tok) if tok in vocab2 else np.nan
+                )
+                continue
+            mat[r, j] = _tokenize_raw_value(name, v, now)
+        for i, name in enumerate(cat_names):
+            v = payload.get(name)
+            if name == "hardship_status" and _scalar_missing(v):
+                v = schema.HARDSHIP_FILL
+            cats = plan.categorical_vocab[name]
+            if not _scalar_missing(v):
+                s = str(v)
+                mat[r, n_num + i] = cats.index(s) if s in cats else -1.0
+    n_classes = tuple(len(plan.categorical_vocab[c]) for c in cat_names)
+    log_mask = np.isin(np.asarray(numeric_names), np.asarray(plan.log_cols))
+    part = SingleDevicePartitioner(kind_prefix="ingest")
+    call = part.compile_rowwise(
+        _make_raw_row_fn(n_classes, n_num),
+        (log_mask,),
+        len(rows),
+        n_num + len(cat_names),
+        kind="raw_row",
+        static_key=(n_classes, n_num),
+        observe=_INGEST_DISPATCH_S.observe,
+    )
+    out = np.asarray(call(jnp.asarray(mat)))
+    if out.shape[1] != len(plan.tree_feature_names):
+        raise ValueError(
+            f"raw transform produced {out.shape[1]} features, plan expects "
+            f"{len(plan.tree_feature_names)}"
+        )
+    return out

@@ -79,6 +79,10 @@ class FeaturePlan:
     log_cols: tuple[str, ...]
     tree_feature_names: tuple[str, ...]
     nn_feature_names: tuple[str, ...]
+    #: ISO date the ingest snapshot used for date -> age features; serve-time
+    #: replay (`transform_raw_rows`) pins its "today" to this so an artifact
+    #: scores a raw row identically no matter when the request arrives.
+    asof: str | None = None
 
 
 def prepare_cleaned_frame(
@@ -125,29 +129,37 @@ def prepare_cleaned_frame(
 # --- Device-side numeric transforms ------------------------------------------
 
 
-@jax.jit
-def _log1p_masked(X: jax.Array, col_mask: jax.Array) -> jax.Array:
+# The plain (un-jitted) bodies are shared with `data/device_pipeline.py`,
+# which traces them inside its own fused `ingest.*` programs: both the pandas
+# path and the device path run the *same code objects*, so the two feature
+# matrices cannot drift apart by construction.
+
+
+def log1p_masked(X: jax.Array, col_mask: jax.Array) -> jax.Array:
     """log1p on masked columns where value is present and positive
     (elementwise-equivalent to feature_engineering.py:134-139)."""
     apply = col_mask[None, :] & (X > 0) & ~jnp.isnan(X)
     return jnp.where(apply, jnp.log1p(X), X)
 
 
-@partial(jax.jit, static_argnames=("n_classes",))
-def _one_hot_codes(codes: jax.Array, n_classes: int) -> jax.Array:
+def one_hot_codes(codes: jax.Array, n_classes: int) -> jax.Array:
     """(N,) int32 codes -> (N, n_classes-1) one-hot, dropping class 0
     (get_dummies drop_first=True; code -1 == missing -> all-zero row)."""
     return (codes[:, None] == jnp.arange(1, n_classes)[None, :]).astype(jnp.float32)
 
 
-@jax.jit
-def _impute_with_indicators(X: jax.Array, medians: jax.Array, need: jax.Array):
+def impute_with_indicators(X: jax.Array, medians: jax.Array, need: jax.Array):
     """Median-fill NaNs; return filled matrix + per-column indicator block for
     the columns flagged in ``need`` (feature_engineering.py:156-162)."""
     isnan = jnp.isnan(X)
     filled = jnp.where(isnan, medians[None, :], X)
     indicators = jnp.where(need[None, :], isnan.astype(jnp.float32), 0.0)
     return filled, indicators
+
+
+_log1p_masked = jax.jit(log1p_masked)
+_one_hot_codes = partial(jax.jit, static_argnames=("n_classes",))(one_hot_codes)
+_impute_with_indicators = jax.jit(impute_with_indicators)
 
 
 def engineer_features(

@@ -26,6 +26,24 @@ CLEAN_UNNECESSARY_COLS = (
 #: clean_data.py:140 — missing assumed to mean zero.
 FILL_ZERO_COLS = ("inq_last_12m", "open_acc_6m", "chargeoff_within_12_mths")
 
+#: clean_data.py:117 — hardship_status null fill token.
+HARDSHIP_FILL = "No Hardship"
+
+# --- Stringy frontier (data/device_pipeline.py) -------------------------------
+# The only columns the host parses to *numbers* during tokenization; every
+# other object column becomes sorted-vocabulary integer codes and all further
+# work runs as jitted columnar ops on device. Each entry names the pandas-path
+# transform it mirrors, so the two paths stay in lockstep.
+
+#: parse_term at clean rule 4 (clean.py).
+FRONTIER_TERM_COLS = ("term",)
+#: parse_percent at clean rule 4 / prepare (clean.py, features.py).
+FRONTIER_PERCENT_COLS = ("int_rate", "revol_util")
+#: emp_length regex extract at prepare (features.py).
+FRONTIER_EMP_COLS = ("emp_length",)
+#: "%b-%Y" date -> age-in-days at prepare (features.py).
+FRONTIER_DATE_COLS = ("earliest_cr_line",)
+
 # --- Feature-engineering stage (src/data_preprocessing/feature_engineering.py) -
 
 #: feature_engineering.py:57 — columns that leak the label.

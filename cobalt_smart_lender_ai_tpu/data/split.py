@@ -22,6 +22,17 @@ def _mix_u32(x: jnp.ndarray, seed: int) -> jnp.ndarray:
     return x ^ (x >> 16)
 
 
+def keep_order(keep: jax.Array) -> jax.Array:
+    """Permutation that stably partitions rows by a boolean ``keep`` mask:
+    kept rows first, each side preserving its original order. Equivalent to
+    boolean indexing but with a static output shape, so it composes inside
+    jit; the caller slices off the first ``sum(keep)`` rows. Shared by the
+    hashed train/test split below and the device-ingest row compactions
+    (`data/device_pipeline.py` uses it for the clean-stage row drops and
+    dedupe, where pandas would `dropna`/`drop_duplicates` on host)."""
+    return jnp.argsort(jnp.logical_not(keep), stable=True)
+
+
 def split_mask(n_rows: int, test_fraction: float, seed: int) -> jax.Array:
     """Boolean mask, True => test row."""
     h = _mix_u32(jnp.arange(n_rows), seed)
@@ -41,7 +52,7 @@ def train_test_split_hashed(X, y, *, test_fraction: float = 0.2, seed: int = 22)
     """
     mask = split_mask(int(X.shape[0]), test_fraction, seed)
     n_train = int(X.shape[0]) - int(jnp.sum(mask))
-    order = jnp.argsort(mask, stable=True)  # False (train) first
+    order = keep_order(jnp.logical_not(mask))  # False (train) first
     Xd = jnp.take(jnp.asarray(X), order, axis=0)
     yd = jnp.take(jnp.asarray(y), order, axis=0)
     return Xd[:n_train], Xd[n_train:], yd[:n_train], yd[n_train:]

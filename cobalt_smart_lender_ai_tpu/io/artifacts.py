@@ -45,24 +45,39 @@ FORMAT_VERSION = 1
 def plan_to_json(plan: FeaturePlan) -> dict:
     return {
         "numeric_names": list(plan.numeric_names),
-        "categorical_vocab": {k: list(v) for k, v in plan.categorical_vocab.items()},
+        # List of pairs, not a dict: artifact headers are dumped with
+        # sort_keys=True, and the one-hot column layout replayed by
+        # transform_raw_rows follows this mapping's iteration order —
+        # alphabetizing it would silently misalign every one-hot block.
+        "categorical_vocab": [
+            [k, list(v)] for k, v in plan.categorical_vocab.items()
+        ],
         "label_vocab": {k: list(v) for k, v in plan.label_vocab.items()},
         "medians": dict(plan.medians),
         "log_cols": list(plan.log_cols),
         "tree_feature_names": list(plan.tree_feature_names),
         "nn_feature_names": list(plan.nn_feature_names),
+        "asof": plan.asof,
     }
 
 
 def plan_from_json(d: Mapping[str, Any]) -> FeaturePlan:
     return FeaturePlan(
         numeric_names=tuple(d["numeric_names"]),
-        categorical_vocab={k: tuple(v) for k, v in d["categorical_vocab"].items()},
+        categorical_vocab={
+            k: tuple(v)
+            for k, v in (
+                d["categorical_vocab"].items()
+                if isinstance(d["categorical_vocab"], dict)  # legacy headers
+                else d["categorical_vocab"]
+            )
+        },
         label_vocab={k: tuple(v) for k, v in d["label_vocab"].items()},
         medians={k: float(v) for k, v in d["medians"].items()},
         log_cols=tuple(d["log_cols"]),
         tree_feature_names=tuple(d["tree_feature_names"]),
         nn_feature_names=tuple(d["nn_feature_names"]),
+        asof=d.get("asof"),
     )
 
 

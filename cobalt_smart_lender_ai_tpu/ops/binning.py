@@ -97,6 +97,20 @@ def transform(spec: BinSpec, X: jax.Array) -> jax.Array:
     return blocks.reshape(n_blocks * R, F)[:N]
 
 
+@partial(jax.jit, static_argnames=("n_bins",))
+def bin_edges_and_transform(
+    X: jax.Array, n_bins: int = 255
+) -> tuple[BinSpec, jax.Array]:
+    """Fused quantile sketch + binning: one program computes the per-feature
+    edges AND maps every value through them, so the device-resident ingest
+    flow (`data/device_pipeline.py`) goes features -> GBDT sketch with no
+    host round-trip between the two. Identical math to calling
+    ``compute_bin_edges`` then ``transform`` back to back (the parity test
+    asserts the composed outputs bit-match)."""
+    spec = compute_bin_edges(X, n_bins=n_bins)
+    return spec, transform(spec, X)
+
+
 def float_threshold(spec: BinSpec, feature: jax.Array, thr_bin: jax.Array) -> jax.Array:
     """Convert a (tree-tensor) bin threshold to the float-space threshold used
     by the serving predict path: ``go_left = x <= edges[feature, thr_bin - 1]``.

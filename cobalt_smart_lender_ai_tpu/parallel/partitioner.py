@@ -131,6 +131,33 @@ def _program_for(
     return default_program_registry().register(name, kind=prefix, meta=meta)
 
 
+def _timed_rowwise_call(prog, compiled, consts, observe):
+    """Dispatch wrapper for `compile_rowwise`: one wall-clock measurement
+    feeds both the program table (attribution numerator) and the caller's
+    measured-seconds family (denominator), so the RunLedger attribution
+    ratio of a row-wise workload is ~1.0 by construction rather than
+    double-timed."""
+
+    # AOT executables pin their input shardings: committed arrays from a
+    # *different* placement (a mesh-sharded matrix entering a single-device
+    # stats program, or vice versa) are rejected rather than auto-resharded.
+    # device_put to the expected sharding is a no-op when it already matches,
+    # so ingest stages can chain across placements freely.
+    x_sharding = compiled.input_shardings[0][-1]
+
+    def call(X):
+        t0 = time.perf_counter()
+        out = compiled(consts, jax.device_put(X, x_sharding))
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        prog.record_dispatch(dt, rows=int(X.shape[0]))
+        if observe is not None:
+            observe(dt)
+        return out
+
+    return call
+
+
 def match_partition_rule(
     rules: Sequence[tuple[str, tuple[Any, ...]]], name: str, dp_axis: str
 ) -> P:
@@ -176,6 +203,33 @@ class Partitioner(abc.ABC):
         self, forest: Any, n_features: int, rows: int
     ) -> Callable[[np.ndarray], tuple[jax.Array, jax.Array]]:
         """AOT-compile ``(rows, F) -> ((rows, F) phis, scalar base)``."""
+
+    @abc.abstractmethod
+    def compile_rowwise(
+        self,
+        fn: Callable[[Any, jax.Array], Any],
+        consts: Any,
+        rows: int,
+        n_features: int,
+        *,
+        kind: str,
+        static_key: tuple = (),
+        observe: Callable[[float], None] | None = None,
+    ) -> Callable[[Any], Any]:
+        """AOT-compile a generic per-row columnar transform.
+
+        ``fn(consts, X)`` takes a replicated consts pytree (array leaves
+        only — bake Python statics into a closure and name them in
+        ``static_key``) plus a ``(rows, n_features)`` float32 matrix, and
+        returns a pytree whose every leaf is row-major along axis 0 (that
+        is the mesh contract: shards split axis 0, so each row's outputs
+        must depend only on that row). The executable is cached under
+        ``(kind, static_key, placement, shapes, consts structure)`` and
+        registered as a named program; callers that maintain their own
+        measured dispatch-seconds family pass ``observe`` to receive the
+        same wall measurement the program table records. Used by the
+        device-resident ingest flow (`data/device_pipeline.py`) for its
+        sharded feature-assembly and binning programs."""
 
     def describe(self) -> dict:
         """Mesh/shard shape for ``/readyz`` and bench records."""
@@ -282,6 +336,38 @@ class SingleDevicePartitioner(Partitioner):
         else:
             prog.ensure_cost(compiled)
         return prog.wrap(lambda X: compiled(forest, X))
+
+    def compile_rowwise(
+        self, fn, consts, rows, n_features, *, kind, static_key=(), observe=None
+    ):
+        key = (
+            "rowwise", kind, static_key, self._device, rows, n_features,
+            _forest_fingerprint(consts),
+        )
+        prog = _program_for(
+            kind,
+            rows=rows,
+            n_features=n_features,
+            device=self._device,
+            prefix=self._kind_prefix,
+        )
+        compiled = _exec_cache_get(key)
+        if compiled is None:
+            t0 = time.perf_counter()
+            with self._ctx():
+                compiled = (
+                    jax.jit(fn)
+                    .lower(
+                        consts,
+                        jax.ShapeDtypeStruct((rows, n_features), jnp.float32),
+                    )
+                    .compile()
+                )
+            prog.record_compile(time.perf_counter() - t0, compiled)
+            compiled = _exec_cache_put(key, compiled)
+        else:
+            prog.ensure_cost(compiled)
+        return _timed_rowwise_call(prog, compiled, consts, observe)
 
     def describe(self) -> dict:
         out = super().describe()
@@ -415,6 +501,48 @@ class MeshPartitioner(Partitioner):
         else:
             prog.ensure_cost(compiled)
         return prog.wrap(lambda X: compiled(forest, X))
+
+    def compile_rowwise(
+        self, fn, consts, rows, n_features, *, kind, static_key=(), observe=None
+    ):
+        self._check_rows(rows)
+        key = (
+            "mesh_rowwise", kind, static_key, self._mesh_key(), rows,
+            n_features, _forest_fingerprint(consts),
+        )
+        prog = _program_for(
+            kind,
+            rows=rows,
+            n_features=n_features,
+            shards=self.n_shards,
+            prefix=self._kind_prefix,
+        )
+        compiled = _exec_cache_get(key)
+        if compiled is None:
+            # Consts replicated (the P() rule applies as a pytree prefix),
+            # rows sharded over dp; every output leaf comes back row-sharded
+            # in order, matching the compile_margin contract.
+            sharded = partial(
+                shard_map,
+                mesh=self._mesh,
+                in_specs=(self._forest_spec, self._rows_spec),
+                out_specs=P(self._dp_axis),
+                check_vma=False,
+            )(fn)
+            t0 = time.perf_counter()
+            compiled = (
+                jax.jit(sharded)
+                .lower(
+                    consts,
+                    jax.ShapeDtypeStruct((rows, n_features), jnp.float32),
+                )
+                .compile()
+            )
+            prog.record_compile(time.perf_counter() - t0, compiled)
+            compiled = _exec_cache_put(key, compiled)
+        else:
+            prog.ensure_cost(compiled)
+        return _timed_rowwise_call(prog, compiled, consts, observe)
 
 
 def make_partitioner(

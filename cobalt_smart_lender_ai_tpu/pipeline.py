@@ -51,12 +51,17 @@ from cobalt_smart_lender_ai_tpu.config import (
 from cobalt_smart_lender_ai_tpu.compilecache import bootstrap_compile_cache
 from cobalt_smart_lender_ai_tpu.data import schema
 from cobalt_smart_lender_ai_tpu.data.clean import clean_raw_frame
+from cobalt_smart_lender_ai_tpu.data.device_pipeline import (
+    run_device_ingest,
+    tokenize_raw_frame,
+)
 from cobalt_smart_lender_ai_tpu.data.features import (
     FeatureFrame,
     drop_training_leakage,
     engineer_features,
     prepare_cleaned_frame,
 )
+from cobalt_smart_lender_ai_tpu.parallel.partitioner import make_partitioner
 from cobalt_smart_lender_ai_tpu.data.split import train_test_split_hashed
 from cobalt_smart_lender_ai_tpu.io import (
     GBDTArtifact,
@@ -226,6 +231,62 @@ def _run_pipeline(
             cfg.data.tree_key,
         )
         t = tick("restore", t)
+    elif cfg.data.device_pipeline and not skip_clean:
+        # Device-resident L1/L2 (data/device_pipeline.py): one host pass
+        # tokenizes the stringy frontier, then clean/prepare/engineer/binning
+        # run as jitted ingest.* programs with no host round-trips. The
+        # logical stages are still "clean"+"engineer" (same checkpoint and
+        # resume contract as the pandas path, whose parity is CI-gated);
+        # only the timings split into host_frontier vs device_ingest so the
+        # ledger stage table can quote the host residual directly.
+        if raw is None:
+            if store is None:
+                raise ValueError("provide a raw frame or an object store")
+            raw = store.load_frame(cfg.data.raw_key)
+        logger.info("raw frame: %d rows x %d cols", len(raw), raw.shape[1])
+        tok = tokenize_raw_frame(raw)
+        t = tick("host_frontier", t)
+        ingest = run_device_ingest(
+            tok,
+            partitioner=make_partitioner(
+                cfg.data.ingest_shards, kind_prefix="ingest"
+            ),
+            n_bins=cfg.gbdt.n_bins,
+            null_col_threshold=cfg.data.null_col_threshold,
+            row_null_allowance=cfg.data.row_null_allowance,
+            keep_cleaned=store is not None and cfg.save_intermediate,
+        )
+        tree_ff, nn_ff, plan = ingest.tree, ingest.nn, ingest.plan
+        report = ingest.report
+        logger.info(
+            "device ingest: %d rows, dropped %d null-heavy cols, %d dupes, "
+            "%d tree features binned",
+            report.n_rows_out,
+            len(report.dropped_null_columns),
+            report.n_duplicates_removed,
+            ingest.bins.shape[1],
+        )
+        if store is not None and cfg.save_intermediate:
+            # The cleaned artifact keeps its key but stores the tokenized
+            # representation (decoded categorical strings, numeric parses)
+            # rather than raw string spellings — see DeviceIngestResult.
+            store.save_frame(cfg.data.cleaned_key, ingest.cleaned)
+            store.save_frame(cfg.data.tree_key, tree_ff.to_pandas())
+            store.save_frame(cfg.data.nn_key, nn_ff.to_pandas())
+            if ckpt is not None:
+                ckpt.write(
+                    "clean",
+                    fingerprint=fp_clean,
+                    outputs=[cfg.data.cleaned_key],
+                )
+                ckpt.write(
+                    "engineer",
+                    fingerprint=fp_engineer,
+                    outputs=[cfg.data.tree_key, cfg.data.nn_key],
+                    extra={"plan": plan_to_json(plan)},
+                )
+        stages_run += ["clean", "engineer"]
+        t = tick("device_ingest", t)
     else:
         if skip_clean:
             cleaned = store.load_frame(cfg.data.cleaned_key)
@@ -487,6 +548,19 @@ def main(argv=None) -> PipelineResult:
         "JSON to this path (open in ui.perfetto.dev)",
     )
     parser.add_argument(
+        "--ingest-shards",
+        type=int,
+        default=1,
+        help="row shards for the device-ingest feature/binning programs "
+        "(1 = single device, -1 = all visible devices)",
+    )
+    parser.add_argument(
+        "--pandas-ingest",
+        action="store_true",
+        help="run L1/L2 through the host pandas path instead of the "
+        "device-resident pipeline (parity fallback)",
+    )
+    parser.add_argument(
         "--ledger-out",
         default=None,
         help="write a run ledger (JSON: config fingerprint, env/devices, "
@@ -519,6 +593,15 @@ def main(argv=None) -> PipelineResult:
     if args.no_halving:
         cfg = dataclasses.replace(
             cfg, tune=dataclasses.replace(cfg.tune, halving_enabled=False)
+        )
+    if args.pandas_ingest or args.ingest_shards != 1:
+        cfg = dataclasses.replace(
+            cfg,
+            data=dataclasses.replace(
+                cfg.data,
+                device_pipeline=not args.pandas_ingest,
+                ingest_shards=args.ingest_shards,
+            ),
         )
     raw = None
     if args.synthetic_rows:

@@ -64,6 +64,7 @@ import pandas as pd
 
 from cobalt_smart_lender_ai_tpu.config import ServeConfig
 from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.data.device_pipeline import transform_raw_rows
 from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
 from cobalt_smart_lender_ai_tpu.models.gbdt import gain_importances
 from cobalt_smart_lender_ai_tpu.parallel.partitioner import (
@@ -1721,6 +1722,62 @@ class ScorerService:
                     row, fut.result(), cache_key, cache_model
                 )
             return self._predict_direct(row, dl, cache_key, cache_model)
+
+    def predict_raw(
+        self, payload: Mapping[str, Any], *, deadline: Deadline | None = None
+    ) -> dict:
+        """Score one RAW LendingClub row — pre-engineering fields: ``term``
+        as ``" 36 months"``, ``int_rate`` as ``"13.56%"``, categorical
+        strings, missing cells absent or null — through the training
+        pipeline's own jitted ingest transform
+        (`data/device_pipeline.transform_raw_rows`) and then the margin
+        program. Train/serve feature skew is impossible by construction:
+        the serve-side transform traces the same tokenize -> log1p ->
+        one-hot code objects the device ingest dispatched at training time,
+        replaying the `FeaturePlan` vocabularies and medians saved with the
+        artifact. Unknown categories score as all-zero one-hot rows and
+        missing numerics as NaN (the GBDT's learned missing direction),
+        exactly as at training time."""
+        with self._ingress_request_id():
+            dl = deadline if deadline is not None else self._new_deadline()
+            model = self._model
+            plan = model.artifact.plan
+            if plan is None:
+                raise ValidationError(
+                    "raw-row scoring requires an artifact that carries its "
+                    "feature plan; this model was saved without one"
+                )
+            if not isinstance(payload, Mapping):
+                raise ValidationError("body must be a JSON object")
+            with self.phase("validate"):
+                feats = transform_raw_rows(plan, [dict(payload)])
+                if dl is not None:
+                    dl.check("raw row transformed")
+            name_pos = {n: i for i, n in enumerate(plan.tree_feature_names)}
+            unknown = [n for n in model.feature_names if n not in name_pos]
+            if unknown:
+                raise ValidationError(
+                    "feature plan does not produce serving features "
+                    f"{unknown[:4]}; retrain with the device pipeline"
+                )
+            x = np.ascontiguousarray(
+                feats[:, [name_pos[n] for n in model.feature_names]],
+                dtype=np.float32,
+            )
+            with self.phase("dispatch"):
+                margin = model.margin_fn(x)
+            prob = float(jax.nn.sigmoid(margin)[0])
+            resp = {
+                "prob_default": prob,
+                "features": list(model.feature_names),
+                "engineered_row": {
+                    n: float(x[0, i])
+                    for i, n in enumerate(model.feature_names)
+                },
+            }
+            if self._model_identity is not None:
+                resp["model_version"] = self._model_identity["version"]
+            return resp
 
     async def predict_single_async(
         self, payload: Mapping[str, Any], *, deadline: Deadline | None = None

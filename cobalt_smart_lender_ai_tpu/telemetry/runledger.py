@@ -35,6 +35,7 @@ _DISPATCH_SECONDS_FAMILIES: tuple[str, ...] = (
     "cobalt_search_dispatch_seconds",
     "cobalt_bulk_dispatch_seconds",
     "cobalt_portfolio_dispatch_seconds",
+    "cobalt_ingest_dispatch_seconds",
 )
 
 

@@ -143,6 +143,23 @@ def _from_bulk(record: dict, metrics: dict) -> None:
         metrics["bulk.best.rows_per_s"] = best
 
 
+def _from_pipeline_ingest(record: dict, metrics: dict) -> None:
+    """BENCH_PIPE / tools/bench_pipeline.py: host-vs-device ingest rows/s
+    per size. The row count joins the series name so each size gates
+    against its own baseline (`rows_per_s` leaves auto-gate at 0.7x)."""
+    for size, row in (record.get("results") or {}).items():
+        if not isinstance(row, dict):
+            continue
+        for path in ("host", "device"):
+            cell = row.get(path)
+            if isinstance(cell, dict):
+                _put(
+                    metrics,
+                    f"pipe.{size}.{path}.rows_per_s",
+                    cell.get("rows_per_s"),
+                )
+
+
 def _from_search(record: dict, metrics: dict) -> None:
     """BENCH_SEARCH / BENCH_SEARCH_WARM / tools/bench_search.py output."""
     compile_block = record.get("compile") or {}
@@ -208,6 +225,8 @@ def extract_metrics(record: dict) -> dict[str, float]:
         _from_bulk(record, metrics)
     elif bench == "search_halving_vs_exhaustive":
         _from_search(record, metrics)
+    elif bench == "pipeline_ingest":
+        _from_pipeline_ingest(record, metrics)
     elif "schema" in record and "kind" in record:
         _from_ledger(record, metrics)
     elif "metric" in record and "value" in record:

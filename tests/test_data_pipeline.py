@@ -28,6 +28,32 @@ def test_parse_term_and_percent():
     np.testing.assert_allclose(out.to_numpy(), [0.1356, 0.07])
 
 
+def test_parse_percent_degenerate_cells():
+    # Whitespace-only / empty / NaN / garbage cells coerce to NaN instead of
+    # raising; clean parses survive alongside them. Already-numeric input
+    # still divides by 100 (clean rule 4 applies it unconditionally).
+    out = parse_percent(pd.Series(["13.56%", "  ", "", None, np.nan, "bogus"]))
+    np.testing.assert_allclose(out.iloc[0], 0.1356)
+    assert out.iloc[1:].isnull().all()
+    np.testing.assert_allclose(
+        parse_percent(pd.Series([13.56, 7.0])).to_numpy(), [0.1356, 0.07]
+    )
+
+
+def test_parse_term_degenerate_cells():
+    # Same tolerance for term: degenerate cells -> NaN, which degrades the
+    # column to float (NaN has no int representation); an all-present
+    # column keeps the reference's int dtype.
+    out = parse_term(pd.Series([" 36 months", "   ", "", None, np.nan]))
+    assert out.iloc[0] == 36.0
+    assert out.dtype.kind == "f"
+    assert out.iloc[1:].isnull().all()
+    clean = parse_term(pd.Series([" 36 months", " 60 months"]))
+    assert clean.dtype.kind == "i"
+    # numeric passthrough keeps values as-is
+    assert parse_term(pd.Series([36.0, 60.0])).tolist() == [36, 60]
+
+
 def test_clean_drops_unnamed_and_sparse_and_duplicates(raw_frame):
     cleaned, report = clean_raw_frame(raw_frame)
     assert "Unnamed: 0" not in cleaned.columns

@@ -1,0 +1,305 @@
+"""Golden parity gates for the device-resident ingest flow (ISSUE 16).
+
+The contract mirrors the mesh bit-parity gates in test_partitioner.py: the
+jitted columnar path (`data/device_pipeline.py`) must reproduce the pandas
+path (`clean.py` -> `features.py`) bit-identically for integer, categorical,
+one-hot and indicator columns, and within float32 tolerance for derived
+floats (log1p outputs and the medians imputed from them — XLA lowers
+`log1p` with 1-ulp differences across fusion contexts, so cross-program
+bit-equality of logged values is not achievable even on one device). The
+mesh run must match the single-device run bit-identically everywhere: both
+trace the same programs, so sharding may not change a single bit.
+"""
+
+from datetime import datetime
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.data.clean import clean_raw_frame
+from cobalt_smart_lender_ai_tpu.data.device_pipeline import (
+    run_device_ingest,
+    tokenize_raw_frame,
+    transform_raw_rows,
+)
+from cobalt_smart_lender_ai_tpu.data.features import (
+    engineer_features,
+    prepare_cleaned_frame,
+)
+from cobalt_smart_lender_ai_tpu.ops import binning
+
+#: Pinned so both paths derive identical `earliest_cr_line_days` ages.
+TODAY = datetime(2026, 8, 1)
+
+#: Relative tolerance for log1p-derived floats: a few ulps of float32.
+LOG_RTOL = 3e-7
+
+
+def _assert_cols(names, A, B, exact_pred, context):
+    """Per-column comparison: exact (NaN==NaN) where `exact_pred`, float32
+    tolerance elsewhere."""
+    assert A.shape == B.shape
+    for j, name in enumerate(names):
+        a, b = A[:, j], B[:, j]
+        nan_ok = np.isnan(a) & np.isnan(b)
+        if exact_pred(name):
+            ok = (a == b) | nan_ok
+            assert ok.all(), (
+                f"{context}: column {name!r} not bit-identical "
+                f"({int((~ok).sum())} cells, first at row {int(np.argmax(~ok))})"
+            )
+        else:
+            ok = np.isclose(a, b, rtol=LOG_RTOL, atol=0.0) | nan_ok
+            assert ok.all(), (
+                f"{context}: column {name!r} outside float32 tolerance"
+            )
+
+
+@pytest.fixture(scope="module")
+def pandas_path(raw_frame):
+    cleaned, report = clean_raw_frame(raw_frame.copy())
+    prepared = prepare_cleaned_frame(cleaned, today=TODAY)
+    tree, nn, plan = engineer_features(prepared)
+    return report, tree, nn, plan
+
+
+@pytest.fixture(scope="module")
+def device_path(raw_frame):
+    tok = tokenize_raw_frame(raw_frame.copy(), today=TODAY)
+    return tok, run_device_ingest(tok)
+
+
+def test_clean_report_parity(pandas_path, device_path):
+    ref, _, _, _ = pandas_path
+    got = device_path[1].report
+    assert got.n_rows_in == ref.n_rows_in
+    assert got.n_rows_out == ref.n_rows_out
+    assert got.n_rows_dropped_near_complete == ref.n_rows_dropped_near_complete
+    assert got.n_duplicates_removed == ref.n_duplicates_removed
+    assert got.dropped_null_columns == ref.dropped_null_columns
+    assert got.dropped_fixed_columns == ref.dropped_fixed_columns
+
+
+def test_plan_parity(pandas_path, device_path):
+    _, _, _, ref = pandas_path
+    got = device_path[1].plan
+    assert got.numeric_names == ref.numeric_names
+    assert dict(got.categorical_vocab) == dict(ref.categorical_vocab)
+    assert dict(got.label_vocab) == dict(ref.label_vocab)
+    assert got.log_cols == ref.log_cols
+    assert got.tree_feature_names == ref.tree_feature_names
+    assert got.nn_feature_names == ref.nn_feature_names
+    assert got.asof == TODAY.strftime("%Y-%m-%d")
+    assert set(got.medians) == set(ref.medians)
+    for k in ref.medians:
+        assert np.isclose(got.medians[k], ref.medians[k], rtol=LOG_RTOL), k
+
+
+def test_tree_matrix_golden_parity(pandas_path, device_path):
+    _, ref, _, plan = pandas_path
+    got = device_path[1].tree
+    assert got.feature_names == ref.feature_names
+    log_cols = set(plan.log_cols)
+    # Everything past the numeric block is a one-hot indicator -> exact;
+    # numeric columns are exact unless log1p touched them.
+    _assert_cols(
+        ref.feature_names,
+        np.asarray(ref.X),
+        np.asarray(got.X),
+        lambda n: n not in log_cols,
+        "tree",
+    )
+    ya, yb = np.asarray(ref.y), np.asarray(got.y)
+    ok = (ya == yb) | (np.isnan(ya) & np.isnan(yb))
+    assert ok.all(), "labels not bit-identical"
+
+
+def test_nn_matrix_golden_parity(pandas_path, device_path):
+    _, _, ref, plan = pandas_path
+    got = device_path[1].nn
+    assert got.feature_names == ref.feature_names
+    # Imputed numeric columns inherit the log tolerance through their
+    # medians; indicators, no_income/dti_NA flags and categorical codes
+    # must be bit-identical.
+    log_cols = set(plan.log_cols)
+    _assert_cols(
+        ref.feature_names,
+        np.asarray(ref.X),
+        np.asarray(got.X),
+        lambda n: n not in log_cols,
+        "nn",
+    )
+
+
+def test_binning_fused_parity(pandas_path, device_path):
+    """The fused sketch must equal composing ops/binning.py's stages on the
+    device path's own features (bit-identical bins), and stay within float
+    tolerance of edges derived from the pandas matrix."""
+    res = device_path[1]
+    spec = binning.compute_bin_edges(res.tree.X, n_bins=255)
+    bins = binning.transform(spec, res.tree.X)
+    assert res.bin_spec.n_bins == 255
+    assert (np.asarray(bins) == np.asarray(res.bins)).all()
+    assert (np.asarray(spec.edges) == np.asarray(res.bin_spec.edges)).all()
+    _, ref_tree, _, _ = pandas_path
+    ref_edges = np.asarray(binning.compute_bin_edges(ref_tree.X, n_bins=255).edges)
+    got_edges = np.asarray(res.bin_spec.edges)
+    ok = (
+        np.isclose(ref_edges, got_edges, rtol=LOG_RTOL, atol=0.0)
+        | (np.isinf(ref_edges) & np.isinf(got_edges))
+    )
+    assert ok.all()
+
+
+def test_mesh_matches_single_device(device_path):
+    """Forced 4-device mesh ingest must match the single-device run
+    bit-identically on every output — the ingest analog of the
+    test_partitioner mesh bit-parity gates."""
+    from cobalt_smart_lender_ai_tpu.parallel.partitioner import make_partitioner
+
+    tok, single = device_path
+    mesh = run_device_ingest(
+        tok, partitioner=make_partitioner(4, kind_prefix="ingest")
+    )
+    for name, a, b in (
+        ("tree", single.tree.X, mesh.tree.X),
+        ("nn", single.nn.X, mesh.nn.X),
+        ("y", single.tree.y, mesh.tree.y),
+        ("bins", single.bins, mesh.bins),
+        ("edges", single.bin_spec.edges, mesh.bin_spec.edges),
+    ):
+        A, B = np.asarray(a), np.asarray(b)
+        ok = (A == B) | (
+            np.isnan(A.astype(np.float64)) & np.isnan(B.astype(np.float64))
+        )
+        assert ok.all(), f"mesh {name} diverged from single-device run"
+
+
+def test_ingest_programs_registered_and_timed(device_path):
+    """RunLedger attribution coverage: every device-ingest stage shows up as
+    a named ingest.* program with nonzero measured dispatch wall."""
+    from cobalt_smart_lender_ai_tpu.telemetry.programs import program_table
+
+    device_path[1].tree.X.block_until_ready()
+    rows = program_table(kind="ingest")
+    kinds = {r["name"].split(".", 1)[1].split("[", 1)[0] for r in rows}
+    assert {
+        "null_stats", "row_compact", "fill", "dedupe",
+        "vocab_census", "stats", "assemble",
+    } <= kinds
+    assert "binning" in kinds or {"sketch", "bin_transform"} <= kinds
+    assert sum(r.get("dispatch_seconds") or 0.0 for r in rows) > 0.0
+
+
+def test_tokenize_degenerate_cells():
+    """Whitespace-only / NaN string cells tokenize to NaN (missing) instead
+    of raising, and the hardship vocabulary gains the clean-stage fill
+    token exactly when the raw column has nulls."""
+    df = pd.DataFrame(
+        {
+            "term": [" 36 months", "   ", None],
+            "int_rate": ["10.0%", "", "5.5%"],
+            "emp_length": ["< 1 year", "10+ years", None],
+            "hardship_status": ["ACTIVE", None, None],
+            "loan_amnt": [1000.0, 2000.0, 3000.0],
+        }
+    )
+    tok = tokenize_raw_frame(df, today=TODAY)
+    X = np.asarray(tok.X)
+    term = X[:, tok.columns.index("term")]
+    assert term[0] == 36.0 and np.isnan(term[1]) and np.isnan(term[2])
+    rate = X[:, tok.columns.index("int_rate")]
+    assert np.isclose(rate[0], 0.10) and np.isnan(rate[1])
+    emp = X[:, tok.columns.index("emp_length")]
+    assert emp[0] == 0.0 and emp[1] == 10.0 and np.isnan(emp[2])
+    hpos = tok.columns.index("hardship_status")
+    assert tok.vocab[hpos] == ("ACTIVE", schema.HARDSHIP_FILL)
+
+
+def test_raw_row_serve_path_no_skew(raw_frame, device_path, tmp_path):
+    """Kills train/serve skew by construction: a raw row scored through
+    `ScorerService.predict_raw` must produce the same engineered features
+    and the same probability as the batch pipeline produced for that row."""
+    import jax
+
+    from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
+    from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    res = device_path[1]
+    plan = res.plan
+    missing = [
+        n for n in schema.SERVING_FEATURES
+        if n not in plan.tree_feature_names
+    ]
+    assert not missing, f"device plan lacks serving features: {missing}"
+    ff = res.tree.select(schema.SERVING_FEATURES)
+    model = GBDTClassifier(n_estimators=25, max_depth=3, n_bins=64)
+    model.fit(np.asarray(ff.X), np.asarray(ff.y))
+    store = ObjectStore(str(tmp_path / "lake"))
+    GBDTArtifact(
+        forest=model.forest,
+        bin_spec=model.bin_spec,
+        feature_names=tuple(schema.SERVING_FEATURES),
+        plan=plan,
+    ).save(store, "models/gbdt/model_tree")
+    svc = ScorerService.from_store(store)
+
+    tree_np = np.asarray(res.tree.X)
+    sel = [plan.tree_feature_names.index(n) for n in schema.SERVING_FEATURES]
+    checked = 0
+    for i in (0, 1, 2):
+        payload = raw_frame.iloc[i].to_dict()
+        feats = transform_raw_rows(plan, [payload], today=TODAY)
+        # The raw row must reproduce its batch-pipeline feature vector
+        # exactly (the row survived cleaning iff it appears in the matrix).
+        eq = (tree_np == feats[0][None, :]) | (
+            np.isnan(tree_np) & np.isnan(feats[0][None, :])
+        )
+        match = np.flatnonzero(eq.all(axis=1))
+        if match.size == 0:
+            continue  # row was dropped by cleaning; nothing to compare
+        resp = svc.predict_raw(payload)
+        assert 0.0 <= resp["prob_default"] <= 1.0
+        assert resp["features"] == list(schema.SERVING_FEATURES)
+        batch_x = np.ascontiguousarray(
+            tree_np[match[0]][sel][None, :], dtype=np.float32
+        )
+        batch_prob = float(
+            jax.nn.sigmoid(svc._model.margin_fn(batch_x))[0]
+        )
+        assert resp["prob_default"] == batch_prob
+        checked += 1
+    assert checked, "no raw row survived into the feature matrix"
+
+
+def test_raw_row_missing_and_unknown_values(device_path):
+    """Missing numerics -> NaN (GBDT missing direction), unknown categories
+    -> all-zero one-hot block, missing hardship -> the clean-stage fill —
+    the training-time semantics, not serving-time improvisation."""
+    plan = device_path[1].plan
+    payload = {
+        "loan_amnt": 10000.0,
+        "term": " 36 months",
+        "int_rate": "11.5%",
+        "grade": "ZZZ-not-a-grade",
+    }
+    out = transform_raw_rows(plan, [payload], today=TODAY)
+    names = list(plan.tree_feature_names)
+    assert out[0][names.index("loan_amnt")] == np.float32(np.log1p(10000.0))
+    assert out[0][names.index("term")] == 36.0
+    grade_cols = [j for j, n in enumerate(names) if n.startswith("grade_")]
+    assert grade_cols and (out[0][grade_cols] == 0.0).all()
+    hs_cols = [
+        j for j, n in enumerate(names) if n.startswith("hardship_status_")
+    ]
+    fill_col = names.index(f"hardship_status_{schema.HARDSHIP_FILL}")
+    expected = {
+        j: (1.0 if j == fill_col else 0.0) for j in hs_cols
+    }
+    for j, want in expected.items():
+        assert out[0][j] == want, names[j]
+    # absent numeric -> NaN
+    assert np.isnan(out[0][names.index("annual_inc")])

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Host-vs-device ingest bench: pandas L1/L2 against the jitted columnar
+pipeline, rows/s at several frame sizes.
+
+Both sides run the full raw-frame -> binned-feature-matrix flow:
+
+- ``host``:   `clean_raw_frame` -> `prepare_cleaned_frame` ->
+              `engineer_features` -> `ops.binning` (the pandas path the
+              device pipeline must match bit-for-bit).
+- ``device``: `tokenize_raw_frame` (the stringy host frontier) ->
+              `run_device_ingest` (jitted ingest.* programs, sharded with
+              ``--shards``).
+
+Each side gets one untimed warmup pass per size to pay the compiles, then
+the best of ``--repeats`` timed passes is kept (BENCH_BULK precedent).
+The record carries ``host_cpu_cores`` because the honest comparison point
+matters: a single-core container understates the pandas side less than a
+big host would, and the CPU "devices" here are cores of the same chip —
+on real TPU hardware the device side does not contend with the frontier.
+
+    python tools/bench_pipeline.py --out BENCH_PIPE_r01.json
+    python tools/perf_sentinel.py ingest BENCH_PIPE_r01.json --no-stamp
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from datetime import datetime
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: Frozen so reruns on other hosts benchmark the same frames.
+TODAY = datetime(2026, 8, 1)
+
+
+def _platform_tag() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def _host_cpu_cores() -> int:
+    if hasattr(os, "sched_getaffinity"):
+        return len(os.sched_getaffinity(0))
+    return os.cpu_count() or 1
+
+
+def _time_best(fn, repeats: int) -> float:
+    fn()  # warmup: compiles, caches, page-in
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run_pipeline_bench(
+    sizes: list[int], *, repeats: int, shards: int, n_bins: int
+) -> dict:
+    import jax
+
+    from cobalt_smart_lender_ai_tpu.data.clean import clean_raw_frame
+    from cobalt_smart_lender_ai_tpu.data.device_pipeline import (
+        run_device_ingest,
+        tokenize_raw_frame,
+    )
+    from cobalt_smart_lender_ai_tpu.data.features import (
+        engineer_features,
+        prepare_cleaned_frame,
+    )
+    from cobalt_smart_lender_ai_tpu.data.synthetic import (
+        synthetic_lendingclub_frame,
+    )
+    from cobalt_smart_lender_ai_tpu.ops import binning
+    from cobalt_smart_lender_ai_tpu.parallel.partitioner import (
+        make_partitioner,
+    )
+
+    results: dict[str, dict] = {}
+    for n in sizes:
+        raw = synthetic_lendingclub_frame(n, seed=7)
+
+        def host_pass():
+            cleaned, _ = clean_raw_frame(raw.copy())
+            prepared = prepare_cleaned_frame(cleaned, today=TODAY)
+            tree, _, _ = engineer_features(prepared)
+            spec = binning.compute_bin_edges(tree.X, n_bins=n_bins)
+            jax.block_until_ready(binning.transform(spec, tree.X))
+
+        def device_pass():
+            tok = tokenize_raw_frame(raw.copy(), today=TODAY)
+            res = run_device_ingest(
+                tok,
+                partitioner=make_partitioner(shards, kind_prefix="ingest"),
+                n_bins=n_bins,
+            )
+            jax.block_until_ready(res.bins)
+
+        print(f"[bench] size={n}: host path...", file=sys.stderr)
+        host_s = _time_best(host_pass, repeats)
+        print(f"[bench] size={n}: device path...", file=sys.stderr)
+        dev_s = _time_best(device_pass, repeats)
+        results[f"rows_{n}"] = {
+            "host": {
+                "rows_per_s": round(n / host_s, 1),
+                "best_pass_ms": round(host_s * 1e3, 3),
+            },
+            "device": {
+                "rows_per_s": round(n / dev_s, 1),
+                "best_pass_ms": round(dev_s * 1e3, 3),
+                "shards": shards,
+            },
+            "speedup": round(host_s / dev_s, 2),
+        }
+        print(
+            f"[bench] size={n}: host {n / host_s:,.0f} rows/s, "
+            f"device {n / dev_s:,.0f} rows/s "
+            f"({host_s / dev_s:.2f}x)",
+            file=sys.stderr,
+        )
+
+    record = {
+        "bench": "pipeline_ingest",
+        "n_bins": n_bins,
+        "repeats": repeats,
+        "platform": _platform_tag(),
+        "devices": len(jax.devices()),
+        "host_cpu_cores": _host_cpu_cores(),
+        "results": results,
+    }
+    largest = f"rows_{max(sizes)}"
+    record["speedup_largest"] = results[largest]["speedup"]
+    return record
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--sizes", default="4000,16000,48000",
+                        help="comma-separated synthetic frame sizes")
+    parser.add_argument("--repeats", type=int, default=3,
+                        help="timed passes per side (best is kept)")
+    parser.add_argument("--shards", type=int, default=-1,
+                        help="device-side ingest shards (-1 = all devices)")
+    parser.add_argument("--n-bins", type=int, default=255)
+    parser.add_argument("--out", default=None,
+                        help="write the record here (default: stdout)")
+    parser.add_argument("--force-devices", type=int, default=None,
+                        help="set --xla_force_host_platform_device_count "
+                        "before JAX loads (no-op if JAX is already up)")
+    args = parser.parse_args(argv)
+
+    if args.force_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.force_devices}"
+        ).strip()
+
+    sizes = sorted(int(s) for s in args.sizes.split(",") if s.strip())
+    record = run_pipeline_bench(
+        sizes,
+        repeats=args.repeats,
+        shards=args.shards,
+        n_bins=args.n_bins,
+    )
+    text = json.dumps(record)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -105,6 +105,18 @@ def render_report(doc: dict) -> str:
                 )
             ],
         )
+        # Device-resident ingest splits L1/L2 wall into a host tokenize pass
+        # and the jitted ingest.* programs; quote the host share directly so
+        # a trend re-anchor can cite it without re-deriving from the table.
+        host_s = stages.get("host_frontier")
+        dev_s = stages.get("device_ingest")
+        if host_s is not None and dev_s is not None and (host_s + dev_s) > 0:
+            lines.append("")
+            lines.append(
+                f"Ingest host residual: {100.0 * host_s / (host_s + dev_s):.1f}% "
+                f"of ingest wall ({_fmt_s(host_s)} stringy-frontier tokenize "
+                f"vs {_fmt_s(dev_s)} device programs)."
+            )
         lines.append("")
 
     programs = doc.get("programs") or []
