@@ -710,6 +710,158 @@ def run_async_http_bench(
     return record
 
 
+def run_chaos_bench(
+    artifact,
+    payloads: list[dict],
+    *,
+    clients: int,
+    duration_s: float,
+    warmup_s: float,
+    replicas: int = 3,
+    mb_kwargs: dict,
+    heal_timeout_s: float = 30.0,
+) -> dict:
+    """The BENCH_CHAOS protocol (chaos-fleet CI job): a supervised
+    N-replica fleet behind the asyncio adapter under closed-loop async
+    clients, with a `ChaosPlan` killing and then hanging one replica's
+    micro-batch worker mid-run. The record is the self-healing headline:
+    ``load.errors`` and ``load.untyped_errors`` must stay 0 (worker
+    watchdog turns the kill into typed ``worker_dead`` futures, hedged
+    failover rescues them on a healthy replica) and the supervisor must
+    quarantine, rebuild and readmit the hurt replica within the heal
+    budget — all without an operator."""
+    import os
+    import threading
+
+    from cobalt_smart_lender_ai_tpu.config import ReliabilityConfig, ServeConfig
+    from cobalt_smart_lender_ai_tpu.reliability import ChaosPlan
+    from cobalt_smart_lender_ai_tpu.serve.replicas import ReplicaSet
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+    from cobalt_smart_lender_ai_tpu.serve.supervisor import HEALTHY
+
+    replicas = max(2, replicas)
+    target = 1 % replicas
+    hang_s = 1.5
+    config = ServeConfig(
+        replicas=replicas,
+        microbatch_enabled=True,
+        score_cache_size=0,
+        prewarm_all_buckets=False,
+        slo_p99_ms=250.0,
+        slo_p999_ms=2000.0,
+        # snappy supervision so time-to-heal measures the rebuild, not the
+        # probe cadence
+        supervisor_probe_interval_s=0.25,
+        supervisor_probe_deadline_s=0.5,
+        supervisor_probe_failures=1,
+        supervisor_drain_timeout_s=2.0,
+        reliability=ReliabilityConfig(max_in_flight=max(256, clients * 2)),
+        **mb_kwargs,
+    )
+    fleet = ReplicaSet(
+        [ScorerService(artifact, config) for _ in range(replicas)], config
+    )
+    port, shutdown = _start_bench_server("asyncio", fleet)  # starts supervisor
+    plan = ChaosPlan(seed=11, registry=fleet.registry)
+    plan.inject(fleet)
+
+    chaos_at: list = [None]
+    healed_in: list = [None]
+
+    def saboteur() -> None:
+        # Mid-run: murder the target's worker (queued futures -> typed
+        # worker_dead, watchdog restarts it), then wedge the restarted
+        # worker so probes time out and the supervisor quarantines + heals.
+        time.sleep(warmup_s + duration_s / 3.0)
+        chaos_at[0] = time.monotonic()
+        plan.kill_worker(replica=target, max_events=1)
+        plan.hang_dispatch(replica=target, hang_s=hang_s, max_events=1)
+        print(
+            f"[bench] chaos: kill + {hang_s:g}s hang on replica {target}",
+            file=sys.stderr,
+        )
+        rebuilds = fleet.supervisor._m_rebuilds.labels(
+            replica=str(target), outcome="ok"
+        )
+        give_up = chaos_at[0] + heal_timeout_s
+        while time.monotonic() < give_up:
+            if rebuilds.value >= 1 and all(
+                h.state == HEALTHY for h in fleet.replica_health
+            ):
+                healed_in[0] = round(time.monotonic() - chaos_at[0], 3)
+                return
+            time.sleep(0.05)
+
+    sab = threading.Thread(target=saboteur, daemon=True)
+    sab.start()
+    print(
+        f"[bench] chaos fleet: {replicas} replicas @ {clients} async "
+        f"clients, {duration_s:g}s measured (+{warmup_s:g}s warmup)...",
+        file=sys.stderr,
+    )
+    try:
+        row = run_async_load(
+            port,
+            payloads,
+            clients=clients,
+            duration_s=duration_s,
+            warmup_s=warmup_s,
+        )
+        sab.join(timeout=heal_timeout_s + 5.0)
+    finally:
+        shutdown()
+    h = fleet.replica_health[target]
+    supervisor_block = {
+        "target_replica": target,
+        "quarantines": h.quarantines,
+        "rebuilds_ok": int(
+            fleet.supervisor._m_rebuilds.labels(
+                replica=str(target), outcome="ok"
+            ).value
+        ),
+        "heal_s": healed_in[0],
+        "states_at_end": [x.state for x in fleet.replica_health],
+        "all_healthy": all(
+            x.state == HEALTHY for x in fleet.replica_health
+        ),
+        "hedges_rescued": int(
+            fleet._m_hedges.labels(outcome="rescued").value
+        ),
+        "worker_restarts": sum(
+            int(rep.batcher.stats().get("worker_restarts", 0))
+            for rep in fleet.replicas
+            if rep.batcher is not None
+        ),
+    }
+    chaos_block = {
+        "seed": 11,
+        "kill_worker_events": int(plan.events.get("kill", 0)),
+        "hang_events": int(plan.events.get("hang", 0)),
+        "hang_s": hang_s,
+        "injected_mid_run": True,
+    }
+    plan.release()
+    fleet.close()
+    record = {
+        "bench": "serve_chaos",
+        "protocol": "kill + hang one replica's micro-batch worker mid-run; "
+        "gate errors==0, untyped==0, heal within budget",
+        "replicas": replicas,
+        "clients": clients,
+        "duration_s": duration_s,
+        "warmup_s": warmup_s,
+        "heal_timeout_s": heal_timeout_s,
+        "load": row,
+        "chaos": chaos_block,
+        "supervisor": supervisor_block,
+        "platform": _platform_tag(),
+        "host_cpu_cores": len(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity")
+        else (os.cpu_count() or 1),
+    }
+    return record
+
+
 def run_bulk_bench(
     artifact,
     X,
@@ -837,6 +989,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--impls", default="asyncio",
                         help="comma-separated adapters for --async-clients "
                         "(only 'asyncio' remains)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="run the self-healing fleet chaos bench: a "
+                        "supervised replica fleet behind the asyncio "
+                        "adapter with one replica's worker killed + hung "
+                        "mid-run (the chaos-fleet CI job protocol)")
+    parser.add_argument("--chaos-replicas", type=int, default=3,
+                        help="fleet size for --chaos")
     parser.add_argument("--http-smoke", action="store_true",
                         help="also drive load over real HTTP and scrape "
                         "/metrics during it (validates the telemetry wiring; "
@@ -973,6 +1132,34 @@ def main(argv: list[str] | None = None) -> int:
             client_counts=client_counts,
             duration_s=args.duration_s,
             warmup_s=args.warmup_s,
+            mb_kwargs=mb_kwargs,
+        )
+        line = json.dumps(record)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(line + "\n")
+        _write_ledger(record)
+        _write_trend(record)
+        return 0
+
+    if args.chaos:
+        print(f"[bench] training model ({args.rows} synthetic rows)...",
+              file=sys.stderr)
+        service, X = build_service(
+            ServeConfig(microbatch_enabled=False, prewarm_all_buckets=False),
+            n_rows=args.rows,
+        )
+        artifact = service.artifact
+        service.close()
+        payloads = build_payloads(X)
+        record = run_chaos_bench(
+            artifact,
+            payloads,
+            clients=args.clients,
+            duration_s=args.duration_s,
+            warmup_s=args.warmup_s,
+            replicas=args.chaos_replicas,
             mb_kwargs=mb_kwargs,
         )
         line = json.dumps(record)

@@ -320,6 +320,44 @@ class ServeConfig:
     #: the device and are thread-backed, which still overlaps host-side
     #: work (validation, padding, serialization) with device dispatches.
     replica_devices: bool = True
+    #: Fleet supervision (serve.supervisor, README "Fleet resilience"): each
+    #: replica carries a health state machine (healthy -> degraded ->
+    #: quarantined -> restarting -> healthy) driven by an error-rate EWMA
+    #: over routed outcomes; a quarantined replica is evicted from routing,
+    #: drained, rebuilt from the currently-published artifact (prewarmed and
+    #: smoke-checked like a reload candidate) and readmitted. The probe-loop
+    #: thread starts with the HTTP server, like the history sampler;
+    #: in-process fleets still get the state machine and router penalty.
+    supervisor_enabled: bool = True
+    #: Probe-loop cadence and the wall-clock budget of each smoke probe (a
+    #: zeros row scored through the replica's own batcher path).
+    supervisor_probe_interval_s: float = 1.0
+    supervisor_probe_deadline_s: float = 2.0
+    #: Consecutive failed probes before a replica is quarantined.
+    supervisor_probe_failures: int = 2
+    #: Error-rate EWMA over routed outcomes: per-outcome smoothing factor
+    #: and the state thresholds. Only replica-*internal* failures count
+    #: (client-typed 422/429/504 are policy, not replica health). With
+    #: alpha 0.2, ~3 consecutive failures reach degraded, ~5 quarantine.
+    supervisor_ewma_alpha: float = 0.2
+    supervisor_degraded_ewma: float = 0.3
+    supervisor_quarantine_ewma: float = 0.6
+    supervisor_recover_ewma: float = 0.1
+    #: Queue-age watchdog: a replica whose oldest queued request exceeds
+    #: this age has a wedged worker (a healthy one drains the queue head
+    #: every coalescing tick) and is quarantined.
+    supervisor_queue_age_limit_s: float = 5.0
+    #: Bounded wait for a quarantined replica's in-flight requests to drain
+    #: before its replacement is swapped in.
+    supervisor_drain_timeout_s: float = 5.0
+    #: Request-level hedged failover ("The Tail at Scale"): a single-row
+    #: request that fails replica-*internally* is retried once on a
+    #: different routable replica, inside the caller's deadline. Typed
+    #: client errors never hedge.
+    hedge_enabled: bool = True
+    #: `ReplicaSet.close` drains replicas concurrently, bounding shutdown at
+    #: roughly one timeout instead of the sum of wedged replicas.
+    replica_close_timeout_s: float = 5.0
     #: Content-hash score cache for repeated single-row payloads: bounded
     #: LRU keyed on the canonicalized (F,) float32 feature vector's bytes,
     #: hit/miss counters in the registry, invalidated on model reload.

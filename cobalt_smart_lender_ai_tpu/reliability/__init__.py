@@ -36,6 +36,13 @@ Request-path hardening (PR 3 — the classic SRE stability patterns):
 - `breaker` — `CircuitBreaker` (closed/open/half-open, injectable clock)
   wrapping store-backed serving operations so a flapping store fails fast
   (`CircuitOpenError` → 503) instead of tying up workers in retries.
+
+Fleet-level chaos (PR 17 — the supervision layer's test primitive):
+
+- `chaos` — `ChaosPlan`: seeded, clock-injectable replica murder (kill the
+  batcher worker, hang dispatch, error-storm, add latency) armed per replica
+  index, so `serve.supervisor` heals under real injected failures in tests
+  and the `chaos-fleet` CI job.
 """
 
 from cobalt_smart_lender_ai_tpu.reliability.admission import (
@@ -50,6 +57,12 @@ from cobalt_smart_lender_ai_tpu.reliability.breaker import (
 from cobalt_smart_lender_ai_tpu.reliability.checkpoint import (
     PipelineCheckpoint,
     config_fingerprint,
+)
+from cobalt_smart_lender_ai_tpu.reliability.chaos import (
+    ChaosError,
+    ChaosPlan,
+    ChaosSpec,
+    WorkerKilled,
 )
 from cobalt_smart_lender_ai_tpu.reliability.deadline import (
     Deadline,
@@ -66,6 +79,7 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
     RequestShed,
     RollbackFailed,
     ValidationError,
+    WorkerDead,
     error_response,
 )
 from cobalt_smart_lender_ai_tpu.reliability.faults import (
@@ -86,6 +100,9 @@ from cobalt_smart_lender_ai_tpu.reliability.stores import (
 
 __all__ = [
     "AdmissionController",
+    "ChaosError",
+    "ChaosPlan",
+    "ChaosSpec",
     "CircuitBreaker",
     "CircuitOpenError",
     "CorruptObjectError",
@@ -105,6 +122,8 @@ __all__ = [
     "RollbackFailed",
     "TokenBucket",
     "ValidationError",
+    "WorkerDead",
+    "WorkerKilled",
     "admission_from_config",
     "breaker_from_config",
     "call_with_retry",

@@ -1,0 +1,295 @@
+"""`ChaosPlan` — scriptable replica murder for the serving fleet.
+
+`FaultInjectingStore` made storage failures a seeded, deterministic test
+primitive; this module does the same for *replica* failures so the fleet
+supervision layer (`serve/supervisor.py`) is exercised under real injected
+chaos instead of asserted. A plan arms per-replica faults and injects them
+at the micro-batch worker's chaos checkpoint:
+
+- ``kill_worker``   — the batcher worker thread raises `WorkerKilled` and
+                      exits, orphaning its queue (the watchdog's job to fix).
+- ``hang_dispatch`` — the worker wedges before dispatch for ``hang_s``
+                      (releasable via `ChaosPlan.release`), so queue age
+                      grows and deadline-bounded probes time out.
+- ``error_storm``   — dispatches raise `ChaosError` (a replica-*internal*
+                      failure: futures resolve with it, the worker lives,
+                      hedged failover and the error EWMA see it).
+- ``add_latency``   — dispatches sleep ``delay_s`` plus a seeded jitter
+                      draw, for tail-latency and queue-age scenarios.
+
+Determinism mirrors `FaultInjectingStore`: one `random.Random(seed)` drawn
+in call order, an injectable ``sleep`` and ``clock``, per-kind event
+counters mirrored into the metrics registry behind a weakref.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+import weakref
+from collections import Counter
+from typing import Callable
+
+from cobalt_smart_lender_ai_tpu.telemetry import (
+    MetricsRegistry,
+    default_registry,
+    get_logger,
+)
+
+_LOG = get_logger("reliability.chaos")
+
+KINDS = ("kill", "hang", "error", "delay")
+
+
+class ChaosError(RuntimeError):
+    """Injected replica-internal dispatch failure. Deliberately *not* a
+    `RequestError`: it models an unexpected bug inside one replica, the
+    exact class of failure hedged failover retries elsewhere."""
+
+
+class WorkerKilled(BaseException):
+    """Raised at the worker's chaos checkpoint. A `BaseException` on
+    purpose: the worker loop contains batch-level `Exception`s, so this is
+    the one thing that escapes and genuinely kills the daemon thread
+    mid-queue — exactly what the watchdog exists to survive."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSpec:
+    """One armed fault profile for one replica.
+
+    - ``kill_worker`` — raise `WorkerKilled` through the worker loop.
+    - ``hang_s`` — wedge the worker this long before dispatching.
+    - ``error_rate`` — probability a dispatch raises `ChaosError`.
+    - ``error_after`` — deterministic variant: first N dispatches clean,
+      later ones raise (until ``max_events`` is spent).
+    - ``delay_s`` / ``delay_jitter_s`` — added dispatch latency; jitter is a
+      seeded uniform draw in ``[0, delay_jitter_s)``.
+    - ``max_events`` — fault budget; ``None`` means unbounded. A bounded
+      budget guarantees the chaos eventually stops and the fleet can heal.
+    """
+
+    kill_worker: bool = False
+    hang_s: float = 0.0
+    error_rate: float = 0.0
+    error_after: int | None = None
+    delay_s: float = 0.0
+    delay_jitter_s: float = 0.0
+    max_events: int | None = None
+
+
+@dataclasses.dataclass
+class _Armed:
+    """A `ChaosSpec` plus its mutable spend state."""
+
+    replica: int
+    spec: ChaosSpec
+    spent: int = 0
+    dispatches: int = 0
+
+    def budget_left(self) -> bool:
+        return self.spec.max_events is None or self.spent < self.spec.max_events
+
+
+class ChaosPlan:
+    """Arms faults per replica index and injects them into a fleet.
+
+    Usage::
+
+        plan = ChaosPlan(seed=7)
+        plan.kill_worker(replica=1)
+        plan.error_storm(replica=1, rate=1.0, max_events=20)
+        plan.inject(fleet)          # or a single ScorerService (replica 0)
+        ...
+        plan.release()              # un-wedge hangs, detach all hooks
+
+    Hooks attach to every replica's `MicroBatcher`; arming *after* inject
+    takes effect immediately (hooks read the armed list dynamically), so a
+    bench can inject once and schedule kills mid-run. A replica rebuilt by
+    the supervisor gets a fresh batcher with no hook — healing clears chaos
+    by construction, like a real process restart would.
+    """
+
+    def __init__(
+        self,
+        *,
+        seed: int = 0,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+        registry: MetricsRegistry | None = None,
+    ):
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._armed: list[_Armed] = []
+        self._hooked: list = []  # batchers we attached to, for release()
+        self._released = threading.Event()
+        self.events: Counter[str] = Counter()
+        self.last_event_at: dict[str, float] = {}
+        self._register_metrics(
+            registry if registry is not None else default_registry()
+        )
+
+    # -- arming ---------------------------------------------------------------
+    def arm(self, replica: int, spec: ChaosSpec) -> "ChaosPlan":
+        with self._lock:
+            self._armed.append(_Armed(replica=int(replica), spec=spec))
+        return self
+
+    def kill_worker(self, replica: int = 0, *, max_events: int = 1) -> "ChaosPlan":
+        return self.arm(replica, ChaosSpec(kill_worker=True, max_events=max_events))
+
+    def hang_dispatch(
+        self, replica: int = 0, hang_s: float = 1.0, *, max_events: int = 1
+    ) -> "ChaosPlan":
+        return self.arm(replica, ChaosSpec(hang_s=hang_s, max_events=max_events))
+
+    def error_storm(
+        self,
+        replica: int = 0,
+        rate: float = 1.0,
+        *,
+        error_after: int | None = None,
+        max_events: int | None = None,
+    ) -> "ChaosPlan":
+        return self.arm(
+            replica,
+            ChaosSpec(error_rate=rate, error_after=error_after, max_events=max_events),
+        )
+
+    def add_latency(
+        self,
+        replica: int = 0,
+        delay_s: float = 0.01,
+        *,
+        jitter_s: float = 0.0,
+        max_events: int | None = None,
+    ) -> "ChaosPlan":
+        return self.arm(
+            replica,
+            ChaosSpec(delay_s=delay_s, delay_jitter_s=jitter_s, max_events=max_events),
+        )
+
+    # -- injection ------------------------------------------------------------
+    def inject(self, target) -> "ChaosPlan":
+        """Attach to every replica batcher of ``target`` (a `ReplicaSet` or a
+        single `ScorerService`, treated as replica 0)."""
+        replicas = getattr(target, "replicas", None) or [target]
+        for i, rep in enumerate(replicas):
+            batcher = getattr(rep, "batcher", None)
+            if batcher is None:
+                continue
+            batcher._chaos = _ReplicaChaos(self, i)
+            self._hooked.append(weakref.ref(batcher))
+        return self
+
+    def release(self) -> None:
+        """Un-wedge any hanging worker and detach every hook; the plan stops
+        injecting even if a batcher still holds a stale reference."""
+        self._released.set()
+        with self._lock:
+            self._armed.clear()
+        for ref in self._hooked:
+            batcher = ref()
+            if batcher is not None:
+                batcher._chaos = None
+        self._hooked.clear()
+
+    # -- the injection engine (called from worker threads) --------------------
+    def _record(self, kind: str) -> None:
+        self.events[kind] += 1
+        self.last_event_at[kind] = self._clock()
+
+    def _hang(self, duration: float) -> None:
+        # Under the default real sleep, hang on the release event so
+        # `release()` can un-wedge a worker early; an injected (fake-clock)
+        # sleep is called directly so tests stay deterministic.
+        if self._sleep is time.sleep:
+            self._released.wait(timeout=duration)
+        else:
+            self._sleep(duration)
+
+    def _on_dispatch(self, replica: int) -> None:
+        """Chaos checkpoint: runs in the worker loop before each dispatch.
+        Raising `WorkerKilled` here escapes the per-batch containment and
+        kills the thread; other kinds sleep or raise `ChaosError` (which the
+        worker resolves the batch's futures with)."""
+        if self._released.is_set():
+            return
+        with self._lock:
+            armed = [a for a in self._armed if a.replica == replica]
+            for a in armed:
+                a.dispatches += 1
+        for a in armed:
+            spec = a.spec
+            if not a.budget_left():
+                continue
+            if spec.delay_s or spec.delay_jitter_s:
+                delay = spec.delay_s + spec.delay_jitter_s * self._rng.random()
+                a.spent += 1
+                self._record("delay")
+                self._sleep(delay)
+            if spec.hang_s and a.budget_left():
+                a.spent += 1
+                self._record("hang")
+                _LOG.warning("chaos_hang", replica=replica, hang_s=spec.hang_s)
+                self._hang(spec.hang_s)
+            if spec.kill_worker and a.budget_left():
+                a.spent += 1
+                self._record("kill")
+                _LOG.warning("chaos_kill_worker", replica=replica)
+                raise WorkerKilled(f"chaos killed replica {replica} worker")
+            storm = spec.error_rate and (
+                spec.error_after is None or a.dispatches > spec.error_after
+            )
+            if storm and a.budget_left() and self._rng.random() < spec.error_rate:
+                a.spent += 1
+                self._record("error")
+                raise ChaosError(
+                    f"chaos error storm on replica {replica} "
+                    f"(dispatch {a.dispatches})"
+                )
+
+    # -- metrics --------------------------------------------------------------
+    def _register_metrics(self, reg: MetricsRegistry) -> None:
+        """Mirror per-kind event counts behind a weakref, `FaultInjectingStore`
+        style: the Counter stays the single writer, a collected plan reads as
+        absent rather than crashing the scrape."""
+        self_ref = weakref.ref(self)
+
+        def _sample(kind: str) -> Callable[[], float]:
+            def read() -> float:
+                plan = self_ref()
+                if plan is None:
+                    raise LookupError("chaos plan was garbage-collected")
+                return float(plan.events.get(kind, 0))
+
+            return read
+
+        fam = reg.counter(
+            "cobalt_chaos_events_total",
+            "chaos faults injected into replica workers",
+            ("kind",),
+        )
+        for kind in KINDS:
+            fam.labels(kind=kind).set_function(_sample(kind))
+
+
+class _ReplicaChaos:
+    """The per-batcher hook: binds a plan to one replica index. The batcher
+    only ever calls `on_dispatch`; keeping the plan behind a weakref means a
+    dropped plan silently stops injecting."""
+
+    __slots__ = ("_plan", "replica")
+
+    def __init__(self, plan: ChaosPlan, replica: int):
+        self._plan = weakref.ref(plan)
+        self.replica = replica
+
+    def on_dispatch(self) -> None:
+        plan = self._plan()
+        if plan is not None:
+            plan._on_dispatch(self.replica)

@@ -24,6 +24,8 @@ The taxonomy (see README "Serving guarantees"):
                             carries ``Retry-After`` (time until half-open)
 504  ``deadline_exceeded``  cooperative cancellation hit the request deadline
 500  ``reload_failed``      hot model swap failed and was rolled back
+500  ``worker_dead``        the micro-batch worker thread died with requests
+                           queued; they are failed typed, never left hanging
 ==== ====================== ==================================================
 """
 
@@ -111,6 +113,17 @@ class ReloadFailed(RequestError):
 
     status = 500
     code = "reload_failed"
+
+
+class WorkerDead(RequestError):
+    """The micro-batch worker thread exited while requests were queued. The
+    watchdog resolves every orphaned future with this typed 500 (a hanging
+    client is worse than a failed one) and restarts the worker. At the fleet
+    level this is a replica-*internal* failure, so hedged failover may retry
+    it once on a different replica — unlike the client-error codes above."""
+
+    status = 500
+    code = "worker_dead"
 
 
 class PromotionRejected(RequestError):

@@ -12,7 +12,18 @@
 - `replicas` — multi-replica engine: N shared-nothing `ScorerService`
   replicas (one per device, or thread-backed on CPU) behind a least-loaded
   router presenting the same service surface, with ``cobalt_replica_*``
-  metrics and atomic all-replica hot reload (README "Scaling out").
+  metrics, atomic all-replica hot reload (README "Scaling out"), and
+  request-level hedged failover: a single-row request that dies with an
+  internal error is retried once on a different replica inside the
+  caller's deadline (README "Fleet resilience").
+- `supervisor` — per-replica health state machine (healthy → degraded →
+  quarantined → restarting → healthy) driven by an error-rate EWMA over
+  routed outcomes plus a deadline-bounded probe loop; quarantined
+  replicas are drained, rebuilt from the published artifact,
+  smoke-checked and swapped back in, with ``cobalt_supervisor_*``
+  telemetry, `/readyz` drill-down, and manual `POST /admin/quarantine` /
+  `POST /admin/readmit` overrides. Chaos faults for testing it live in
+  `reliability.chaos` (README "Fleet resilience").
 - `http_asyncio` — the default zero-dependency frontend: one asyncio event
   loop from socket accept to batcher future. Request coroutines suspend on
   ``MicroBatcher.submit_async`` / deadline awaits instead of parking OS
@@ -27,7 +38,8 @@
 
 Both adapters map failures through the one error taxonomy in
 `reliability.errors` (422 invalid_input / 413 payload_too_large / 429 shed /
-503 circuit_open / 504 deadline_exceeded — README "Serving guarantees").
+503 circuit_open / 504 deadline_exceeded / 500 worker_dead — README
+"Serving guarantees").
 
 Entry point: ``python -m cobalt_smart_lender_ai_tpu.serve --store <uri>``.
 """

@@ -198,6 +198,11 @@ class AsyncScorerServer:
         start_history = getattr(self.service, "start_history", None)
         if start_history is not None:
             start_history()
+        # Same rule for fleet supervision: the probe/heal loop only makes
+        # sense once traffic can arrive, so it starts with the socket.
+        start_supervisor = getattr(self.service, "start_supervisor", None)
+        if start_supervisor is not None:
+            start_supervisor()
         return self
 
     def start(self) -> "AsyncScorerServer":
@@ -499,6 +504,37 @@ class AsyncScorerServer:
                 200,
                 await _in_executor(service.rollback_model, reason=reason),
             )
+            return
+        if st.route_path in ("/admin/quarantine", "/admin/readmit"):
+            # Fleet admin plane: evict a replica from routing (drain +
+            # supervisor-managed rebuild) or hand it back. Ungated like the
+            # other admin routes — an operator must be able to pull a sick
+            # replica while the data plane is shedding.
+            payload = self._json_body(body)
+            if not isinstance(payload, dict):
+                raise ValidationError("body must be a JSON object")
+            replica = payload.get("replica")
+            if st.route_path == "/admin/quarantine":
+                fn = getattr(service, "quarantine_replica", None)
+                if fn is None:
+                    raise ValidationError(
+                        "service is not a replicated fleet; "
+                        "/admin/quarantine requires replicas >= 2"
+                    )
+                result = await _in_executor(
+                    fn,
+                    replica,
+                    reason=str(payload.get("reason", "manual quarantine")),
+                )
+            else:
+                fn = getattr(service, "readmit_replica", None)
+                if fn is None:
+                    raise ValidationError(
+                        "service is not a replicated fleet; "
+                        "/admin/readmit requires replicas >= 2"
+                    )
+                result = await _in_executor(fn, replica)
+            await self._send(st, 200, result)
             return
         if st.route_path == "/predict":
             # The admission slot brackets the whole await — same atomicity

@@ -124,6 +124,13 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
     class RollbackInput(BaseModel):
         reason: str = "manual"
 
+    class QuarantineInput(BaseModel):
+        replica: int
+        reason: str = "manual quarantine"
+
+    class ReadmitInput(BaseModel):
+        replica: int
+
     state: dict[str, ScorerService] = {}
     if service is not None:
         state["service"] = service
@@ -140,6 +147,11 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
         start_history = getattr(state["service"], "start_history", None)
         if start_history is not None:
             start_history()
+        # Same rule for fleet supervision: the probe/heal loop starts when
+        # the app can take traffic.
+        start_supervisor = getattr(state["service"], "start_supervisor", None)
+        if start_supervisor is not None:
+            start_supervisor()
         yield
         if owns_service:
             # shutdown: drain the micro-batch scheduler (a service passed in
@@ -330,6 +342,52 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
                     state["service"].rollback_model,
                     reason=data.reason if data is not None else "manual",
                 )
+            except RequestError as e:
+                _raise_typed(e)
+
+    @app.post("/admin/quarantine")
+    async def admin_quarantine(
+        data: QuarantineInput, request: Request = None, response: Response = None
+    ):
+        # Fleet admin plane: evict a replica from routing (the supervisor
+        # drains and rebuilds it) — ungated like the other admin routes.
+        with _track("/admin/quarantine", request, response):
+            from cobalt_smart_lender_ai_tpu.serve.service import _in_executor
+
+            fn = getattr(state["service"], "quarantine_replica", None)
+            if fn is None:
+                exc = HTTPException(
+                    status_code=422,
+                    detail="service is not a replicated fleet; "
+                    "/admin/quarantine requires replicas >= 2",
+                )
+                exc.cobalt_code = "invalid_input"
+                raise exc
+            try:
+                return await _in_executor(
+                    fn, data.replica, reason=data.reason
+                )
+            except RequestError as e:
+                _raise_typed(e)
+
+    @app.post("/admin/readmit")
+    async def admin_readmit(
+        data: ReadmitInput, request: Request = None, response: Response = None
+    ):
+        with _track("/admin/readmit", request, response):
+            from cobalt_smart_lender_ai_tpu.serve.service import _in_executor
+
+            fn = getattr(state["service"], "readmit_replica", None)
+            if fn is None:
+                exc = HTTPException(
+                    status_code=422,
+                    detail="service is not a replicated fleet; "
+                    "/admin/readmit requires replicas >= 2",
+                )
+                exc.cobalt_code = "invalid_input"
+                raise exc
+            try:
+                return await _in_executor(fn, data.replica)
             except RequestError as e:
                 _raise_typed(e)
 

@@ -47,6 +47,8 @@ _KNOWN_ROUTES = frozenset(
         "/admin/reload",
         "/admin/promote",
         "/admin/rollback",
+        "/admin/quarantine",
+        "/admin/readmit",
         "/healthz",
         "/readyz",
         "/metrics",

@@ -16,7 +16,15 @@ Routing is least-loaded: every request picks the replica minimizing
 ``in_flight + microbatch queue depth`` — the same two signals the telemetry
 gauges already export — with round-robin tie-breaking so an idle fleet still
 spreads warmup traffic. A stalled replica's in-flight count stays high, so
-the router organically drains around it (`tests/test_replicas.py`).
+the router organically drains around it (`tests/test_replicas.py`). Two
+health signals temper the load score (README "Fleet resilience"): replicas
+quarantined by the supervision layer (`serve/supervisor.py`) are skipped
+outright, and a recent-error penalty (the per-replica error EWMA scaled
+into load units) keeps a fast-failing replica — which reports zero load —
+from attracting the whole fleet's traffic. Single-row requests that fail
+replica-*internally* are hedged: retried once on a different replica within
+the caller's deadline ("The Tail at Scale"); typed client errors never
+hedge.
 
 The facade duck-types the full `ScorerService` surface the HTTP adapters
 bind to (`make_async_server(service)` / `create_app(service)` work
@@ -44,7 +52,17 @@ from cobalt_smart_lender_ai_tpu.io.store import ObjectStore
 from cobalt_smart_lender_ai_tpu.reliability.admission import (
     admission_from_config,
 )
+from cobalt_smart_lender_ai_tpu.reliability.errors import ValidationError
 from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+from cobalt_smart_lender_ai_tpu.serve.supervisor import (
+    HEALTHY,
+    QUARANTINED,
+    RESTARTING,
+    STATE_CODES,
+    FleetSupervisor,
+    ReplicaHealth,
+    replica_internal,
+)
 from cobalt_smart_lender_ai_tpu.telemetry import (
     FlightRecorder,
     MetricsRegistry,
@@ -99,6 +117,21 @@ class ReplicaSet:
         self._route_lock = threading.Lock()
         self._inflight = [0] * len(replicas)
         self._rr = 0  # round-robin tie-break cursor
+        # Per-replica health state machines (serve.supervisor): always
+        # present — the router reads ``routable`` and ``error_ewma`` on
+        # every pick — while the healing loop below is config-gated.
+        self.replica_health = [
+            ReplicaHealth(
+                i,
+                alpha=config.supervisor_ewma_alpha,
+                degraded_ewma=config.supervisor_degraded_ewma,
+                quarantine_ewma=config.supervisor_quarantine_ewma,
+                recover_ewma=config.supervisor_recover_ewma,
+                clock=clock,
+            )
+            for i in range(len(replicas))
+        ]
+        self.supervisor: FleetSupervisor | None = None
         # Fleet-level request surface: one admission controller gates the
         # fleet's door (the adapters call ``admission.admit()`` once per
         # request — per-replica admission would double-count), and the
@@ -122,6 +155,13 @@ class ReplicaSet:
         self.canary = None
         self._model_identity: dict | None = None
         self._init_metrics()
+        # The healing loop (probe thread, quarantine/rebuild/readmit).
+        # Constructed here so the state machine can auto-quarantine (there
+        # is something to heal it) and the supervisor metric families exist
+        # for every supervised fleet; the thread itself starts with the
+        # HTTP server (`start_supervisor`), like the history sampler.
+        if config.supervisor_enabled:
+            self.supervisor = FleetSupervisor(self, clock=clock)
         if config.slo_enabled:
             self.slo = SLOEngine(
                 self.registry,
@@ -177,6 +217,14 @@ class ReplicaSet:
         `ScorerService.start_history`."""
         if self.history is not None:
             self.history.start()
+
+    def start_supervisor(self) -> None:
+        """Start the supervision probe loop (idempotent) — called by the
+        adapters when their socket opens. In-process fleets keep the state
+        machine and router penalty without the background thread; tests
+        drive `FleetSupervisor.tick` directly instead."""
+        if self.supervisor is not None:
+            self.supervisor.start()
 
     @classmethod
     def from_store(
@@ -277,6 +325,45 @@ class ReplicaSet:
             "requests the least-loaded router sent to each replica",
             ("replica",),
         )
+        # Supervision families (serve.supervisor): state + EWMA are
+        # collect-time reads of the health records; transitions, hedges and
+        # quarantines are incremented at the event.
+        g_state = reg.gauge(
+            "cobalt_supervisor_state",
+            "replica health state (0 healthy, 1 degraded, 2 quarantined, "
+            "3 restarting)",
+            ("replica",),
+        )
+        g_ewma = reg.gauge(
+            "cobalt_supervisor_error_ewma",
+            "per-replica error-rate EWMA over routed outcomes "
+            "(replica-internal failures only)",
+            ("replica",),
+        )
+        for i in range(len(self.replicas)):
+            g_state.labels(replica=str(i)).set_function(
+                lambda i=i: float(STATE_CODES[self.replica_health[i].state])
+            )
+            g_ewma.labels(replica=str(i)).set_function(
+                lambda i=i: self.replica_health[i].error_ewma
+            )
+        self._m_transitions = reg.counter(
+            "cobalt_supervisor_transitions_total",
+            "replica health-state transitions by replica and target state",
+            ("replica", "to"),
+        )
+        self._m_quarantines = reg.counter(
+            "cobalt_supervisor_quarantines_total",
+            "replica quarantines by trigger (auto: supervisor; manual: "
+            "POST /admin/quarantine)",
+            ("replica", "trigger"),
+        )
+        self._m_hedges = reg.counter(
+            "cobalt_replica_hedges_total",
+            "hedged single-row failovers by outcome (rescued: the retry "
+            "answered; failed: the retry also errored)",
+            ("outcome",),
+        )
         self._m_reloads = reg.counter(
             "cobalt_model_reloads_total",
             "fleet-wide hot swap attempts by outcome (ok / rolled_back)",
@@ -307,20 +394,23 @@ class ReplicaSet:
             "device dispatches issued by each replica's bulk path",
             ("replica",),
         )
-        for i, rep in enumerate(self.replicas):
+        # Closures capture the INDEX, not the replica object: the supervisor
+        # swaps healed replicas in place (`_swap_replica`), and the gauges
+        # must follow the slot, not a dead object.
+        for i in range(len(self.replicas)):
             g_inflight.labels(replica=str(i)).set_function(
                 lambda i=i: self._inflight[i]
             )
             g_queue.labels(replica=str(i)).set_function(
-                lambda r=rep: 0
-                if r.batcher is None
-                else r.batcher.queue_depth()
+                lambda i=i: 0
+                if self.replicas[i].batcher is None
+                else self.replicas[i].batcher.queue_depth()
             )
             c_bulk_rows.labels(replica=str(i)).set_function(
-                lambda r=rep: r._m_bulk_rows.value
+                lambda i=i: self.replicas[i]._m_bulk_rows.value
             )
             c_bulk_disp.labels(replica=str(i)).set_function(
-                lambda r=rep: r._m_bulk_dispatches.value
+                lambda i=i: self.replicas[i]._m_bulk_dispatches.value
             )
         from cobalt_smart_lender_ai_tpu.telemetry.devices import (
             install_device_metrics,
@@ -345,44 +435,158 @@ class ReplicaSet:
 
     # -- routing ---------------------------------------------------------------
 
-    def _load_of(self, i: int) -> int:
+    #: Load units one full point of error EWMA costs a replica in the pick:
+    #: a replica erroring on every recent request carries the same weight as
+    #: 16 queued requests, so traffic prefers a busy-but-healthy replica
+    #: over an idle-but-failing one (the dead-replica black hole: a replica
+    #: failing instantly reports ZERO in-flight/queue load and would
+    #: otherwise win every least-loaded comparison).
+    _ERROR_PENALTY = 16.0
+
+    def _load_of(self, i: int) -> float:
         rep = self.replicas[i]
         queued = 0 if rep.batcher is None else rep.batcher.queue_depth()
-        return self._inflight[i] + queued
+        penalty = self._ERROR_PENALTY * self.replica_health[i].error_ewma
+        return self._inflight[i] + queued + penalty
 
-    def _pick(self) -> int:
-        """Least-loaded replica index; round-robin among the tied so an idle
-        fleet still rotates (warm caches everywhere, not hotspot replica 0)."""
+    def _pick(self, exclude: tuple[int, ...] = ()) -> int:
+        """Least-loaded *routable* replica index; round-robin among the tied
+        so an idle fleet still rotates (warm caches everywhere, not hotspot
+        replica 0). Quarantined/restarting replicas are skipped; if that
+        evicts the whole fleet, fail open to least-loaded over everyone — a
+        degraded answer beats a blackout. ``exclude`` is the hedge path's
+        "not the replica that just failed me"."""
         with self._route_lock:
             n = len(self.replicas)
             best, best_load = None, None
-            for off in range(n):
-                i = (self._rr + off) % n
-                load = self._load_of(i)
-                if best_load is None or load < best_load:
-                    best, best_load = i, load
+            for routable_only in (True, False):
+                for off in range(n):
+                    i = (self._rr + off) % n
+                    if i in exclude:
+                        continue
+                    if routable_only and not self.replica_health[i].routable:
+                        continue
+                    load = self._load_of(i)
+                    if best_load is None or load < best_load:
+                        best, best_load = i, load
+                if best is not None:
+                    break
+            if best is None:
+                raise RuntimeError(
+                    "no replica available to route to "
+                    f"(fleet of {n}, excluded {sorted(exclude)})"
+                )
             self._rr = (best + 1) % n
             self._inflight[best] += 1
         self._m_routed.labels(replica=str(best)).inc()
         return best
 
     @contextlib.contextmanager
-    def _routed(self):
-        i = self._pick()
+    def _routed(self, exclude: tuple[int, ...] = ()):
+        """Route one call: yields ``(index, replica)``, brackets the
+        in-flight count, and folds the outcome into the replica's health
+        EWMA — only replica-*internal* failures count against it
+        (`serve.supervisor.replica_internal`); typed client errors would
+        fail anywhere."""
+        i = self._pick(exclude)
+        ok = True
         try:
             with default_tracer().span("serve.route", replica=i):
-                yield self.replicas[i]
+                yield i, self.replicas[i]
+        except BaseException as exc:
+            ok = not replica_internal(exc)
+            raise
         finally:
             with self._route_lock:
                 self._inflight[i] -= 1
+            self._record_outcome(i, ok)
+
+    def _record_outcome(self, i: int, ok: bool) -> None:
+        h = self.replica_health[i]
+        # Auto-quarantine only when a supervisor exists to heal it;
+        # otherwise the machine tops out at degraded and the router
+        # penalty does the shielding.
+        transition = h.record_outcome(
+            ok, allow_quarantine=self.supervisor is not None
+        )
+        if transition is not None:
+            self._note_transition(i, *transition)
+            if transition[1] == QUARANTINED:
+                self._m_quarantines.labels(
+                    replica=str(i), trigger="auto"
+                ).inc()
+
+    def _note_transition(self, i: int, old: str, new: str) -> None:
+        """Every health transition is logged, traced, and counted."""
+        h = self.replica_health[i]
+        self._m_transitions.labels(replica=str(i), to=new).inc()
+        with default_tracer().span(
+            "supervisor.transition", replica=i, frm=old, to=new
+        ):
+            pass
+        log = _LOG.warning if new in (QUARANTINED, RESTARTING) else _LOG.info
+        log(
+            "replica_health_transition",
+            replica=i,
+            frm=old,
+            to=new,
+            reason=h.reason,
+            error_ewma=round(h.error_ewma, 4),
+        )
+
+    def _swap_replica(self, i: int, replacement: ScorerService) -> ScorerService:
+        """Publish a rebuilt replica into routing slot ``i`` (the supervisor
+        heal path). Under the route lock so no pick sees a half-swapped
+        slot; the per-slot metric closures read ``self.replicas[i]`` and
+        follow automatically."""
+        with self._route_lock:
+            old, self.replicas[i] = self.replicas[i], replacement
+        return old
 
     # -- the adapter-facing surface --------------------------------------------
+
+    def _hedge_target(self, exc: BaseException, deadline, failed: int | None):
+        """Decide whether a failed single-row attempt may retry on another
+        replica: hedging must be on, a different replica must exist, the
+        failure must be replica-*internal* (a typed 422/429/504 would fail
+        identically anywhere — never hedge policy), and the caller's
+        deadline must have budget left (a hedge never violates it). Returns
+        the exclusion tuple for the retry pick, or None."""
+        if (
+            not self.config.hedge_enabled
+            or failed is None
+            or len(self.replicas) < 2
+            or not replica_internal(exc)
+        ):
+            return None
+        if deadline is not None and deadline.remaining() <= 0.0:
+            return None
+        return (failed,)
 
     def predict_single(
         self, payload: Mapping[str, Any], *, deadline=None
     ) -> dict:
-        with self._routed() as rep:
-            resp = rep.predict_single(payload, deadline=deadline)
+        first: int | None = None
+        try:
+            with self._routed() as (i, rep):
+                first = i
+                resp = rep.predict_single(payload, deadline=deadline)
+        except BaseException as exc:
+            exclude = self._hedge_target(exc, deadline, first)
+            if exclude is None:
+                raise
+            _LOG.warning(
+                "hedged_failover",
+                failed_replica=first,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            try:
+                with self._routed(exclude) as (_i, rep):
+                    resp = rep.predict_single(payload, deadline=deadline)
+            except BaseException:
+                self._m_hedges.labels(outcome="failed").inc()
+                raise
+            self._m_hedges.labels(outcome="rescued").inc()
         # The replicas serve anonymously (their `_model_identity` stays
         # None); the fleet's identity and shadow tap live on the facade.
         if self._model_identity is not None:
@@ -399,9 +603,34 @@ class ReplicaSet:
         (never block on I/O), so the least-loaded router works unchanged on
         the event loop — the in-flight count brackets the full await, and
         the fleet canary taps from the loop thread (a bounded non-blocking
-        append; serve/canary.py)."""
-        with self._routed() as rep:
-            resp = await rep.predict_single_async(payload, deadline=deadline)
+        append; serve/canary.py). Hedged failover mirrors the sync path:
+        one retry on a different replica, replica-internal failures only,
+        inside the caller's deadline."""
+        first: int | None = None
+        try:
+            with self._routed() as (i, rep):
+                first = i
+                resp = await rep.predict_single_async(
+                    payload, deadline=deadline
+                )
+        except BaseException as exc:
+            exclude = self._hedge_target(exc, deadline, first)
+            if exclude is None:
+                raise
+            _LOG.warning(
+                "hedged_failover",
+                failed_replica=first,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            try:
+                with self._routed(exclude) as (_i, rep):
+                    resp = await rep.predict_single_async(
+                        payload, deadline=deadline
+                    )
+            except BaseException:
+                self._m_hedges.labels(outcome="failed").inc()
+                raise
+            self._m_hedges.labels(outcome="rescued").inc()
         if self._model_identity is not None:
             resp["model_version"] = self._model_identity["version"]
         can = self.canary
@@ -410,35 +639,35 @@ class ReplicaSet:
         return resp
 
     def predict_bulk_csv(self, csv_bytes: bytes, *, deadline=None) -> dict:
-        with self._routed() as rep:
+        with self._routed() as (_i, rep):
             return rep.predict_bulk_csv(csv_bytes, deadline=deadline)
 
     async def predict_bulk_csv_async(
         self, csv_bytes: bytes, *, deadline=None
     ) -> dict:
-        with self._routed() as rep:
+        with self._routed() as (_i, rep):
             return await rep.predict_bulk_csv_async(csv_bytes, deadline=deadline)
 
     def feature_importance_bulk(
         self, payload: Mapping[str, Any], *, deadline=None
     ) -> dict:
-        with self._routed() as rep:
+        with self._routed() as (_i, rep):
             return rep.feature_importance_bulk(payload, deadline=deadline)
 
     async def feature_importance_bulk_async(
         self, payload: Mapping[str, Any], *, deadline=None
     ) -> dict:
-        with self._routed() as rep:
+        with self._routed() as (_i, rep):
             return await rep.feature_importance_bulk_async(
                 payload, deadline=deadline
             )
 
     def predict_proba(self, X: np.ndarray, deadline=None) -> np.ndarray:
-        with self._routed() as rep:
+        with self._routed() as (_i, rep):
             return rep.predict_proba(X, deadline=deadline)
 
     def shap_bulk(self, X: np.ndarray, deadline=None):
-        with self._routed() as rep:
+        with self._routed() as (_i, rep):
             return rep.shap_bulk(X, deadline=deadline)
 
     # -- observability hooks the adapters call ---------------------------------
@@ -490,7 +719,16 @@ class ReplicaSet:
         — replica count, device pinning, mesh — at the top for the CI
         bulk-smoke assert."""
         per = [rep.ready() for rep in self.replicas]
-        all_ready = all(ok for ok, _ in per)
+        routable = [h.routable for h in self.replica_health]
+        # Readiness is judged over the replicas the router can actually
+        # reach: a fleet healing one quarantined replica still serves (that
+        # is the point of supervision), but a fleet with nothing routable
+        # is down no matter what the evicted replicas report.
+        all_ready = any(routable) and all(
+            ok for (ok, _), r in zip(per, routable) if r
+        )
+        for (_, p), h in zip(per, self.replica_health):
+            p["supervisor"] = h.snapshot()
         payload = {
             "status": "ok" if all_ready else "unavailable",
             "replicas": len(self.replicas),
@@ -501,7 +739,16 @@ class ReplicaSet:
             "router": {
                 "policy": "least_loaded",
                 "in_flight": list(self._inflight),
+                "routable": routable,
             },
+            "supervisor": (
+                self.supervisor.status()
+                if self.supervisor is not None
+                else {
+                    "enabled": False,
+                    "states": [h.state for h in self.replica_health],
+                }
+            ),
             "bulk": per[0][1].get("bulk"),
             "admission": self.admission.stats(),
             "per_replica": [p for _, p in per],
@@ -664,10 +911,87 @@ class ReplicaSet:
             return {"status": "disabled"}
         return self.canary.drift_report()
 
+    # -- manual supervision (POST /admin/quarantine, /admin/readmit) -----------
+
+    def _check_replica_index(self, index) -> int:
+        try:
+            i = int(index)
+        except (TypeError, ValueError):
+            raise ValidationError(f"replica must be an integer, got {index!r}")
+        if not 0 <= i < len(self.replicas):
+            raise ValidationError(
+                f"replica {i} out of range for a fleet of {len(self.replicas)}"
+            )
+        return i
+
+    def quarantine_replica(
+        self, index, *, reason: str = "manual quarantine"
+    ) -> dict:
+        """Operator eviction: the replica stops receiving traffic until
+        ``POST /admin/readmit`` — the supervisor deliberately leaves manual
+        quarantines alone (the operator owns the replica while they debug
+        it). Refuses to evict the last routable replica."""
+        i = self._check_replica_index(index)
+        h = self.replica_health[i]
+        if h.state in (QUARANTINED, RESTARTING):
+            return {"status": h.state, "replica": i, "supervisor": h.snapshot()}
+        if sum(x.routable for x in self.replica_health) <= 1:
+            raise ValidationError(
+                "refusing to quarantine the last routable replica "
+                "(the fleet would go dark)"
+            )
+        self._note_transition(i, *h.to(QUARANTINED, reason, manual=True))
+        self._m_quarantines.labels(replica=str(i), trigger="manual").inc()
+        return {
+            "status": "quarantined",
+            "replica": i,
+            "reason": reason,
+            "supervisor": h.snapshot(),
+        }
+
+    def readmit_replica(self, index) -> dict:
+        """Operator readmission of a quarantined replica: health state and
+        EWMA reset, traffic resumes immediately. No rebuild — readmitting
+        is the operator asserting the replica is fine as-is; the automatic
+        heal path (rebuild + smoke-check) is the supervisor's."""
+        i = self._check_replica_index(index)
+        h = self.replica_health[i]
+        if h.state not in (QUARANTINED, RESTARTING):
+            raise ValidationError(
+                f"replica {i} is {h.state}, not quarantined — nothing to "
+                "readmit"
+            )
+        self._note_transition(i, *h.to(HEALTHY, "manual readmit"))
+        return {"status": "readmitted", "replica": i, "supervisor": h.snapshot()}
+
     def close(self) -> None:
+        """Shut the fleet down with replicas draining CONCURRENTLY under a
+        bounded timeout: closing serially would stack worker-join waits, so
+        one wedged replica (a chaos-hung worker, a stuck dispatch) could
+        hold shutdown for the whole fleet. Stragglers are left to their
+        daemon threads and logged, not waited for."""
+        if self.supervisor is not None:
+            self.supervisor.stop()
         if self.canary is not None:
             self.canary.close()
         if self.history is not None:
             self.history.stop()
-        for rep in self.replicas:
-            rep.close()
+        timeout = max(0.1, float(self.config.replica_close_timeout_s))
+        closers = [
+            threading.Thread(
+                target=rep.close, daemon=True, name=f"replica-close-{i}"
+            )
+            for i, rep in enumerate(self.replicas)
+        ]
+        for t in closers:
+            t.start()
+        give_up = time.monotonic() + timeout
+        for t in closers:
+            t.join(timeout=max(0.0, give_up - time.monotonic()))
+        stragglers = [t.name for t in closers if t.is_alive()]
+        if stragglers:
+            _LOG.warning(
+                "replica_close_timeout",
+                timeout_s=timeout,
+                stragglers=stragglers,
+            )

@@ -55,6 +55,7 @@ import math
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Any, Callable, Mapping, Sequence
 
 import jax
@@ -87,6 +88,7 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
     DeadlineExceeded,
     PayloadTooLarge,
     ValidationError,
+    WorkerDead,
 )
 from cobalt_smart_lender_ai_tpu.telemetry import (
     FlightRecorder,
@@ -547,6 +549,12 @@ class MicroBatcher:
         self._paused = 0
         self._closed = False
         self._scratch: np.ndarray | None = None  # worker-only padding buffer
+        # Chaos checkpoint hook (`reliability.chaos.ChaosPlan.inject` sets
+        # it); None in production. Read once per loop iteration.
+        self._chaos = None
+        # Guards worker (re)starts so a dead worker is replaced exactly once
+        # even when the dying thread and a submitter race `ensure_worker`.
+        self._worker_lock = threading.Lock()
         reg = service.registry
         self._m_batches = reg.counter(
             "cobalt_microbatch_batches_total",
@@ -576,6 +584,19 @@ class MicroBatcher:
             "cobalt_microbatch_max_batch_rows",
             "largest batch coalesced so far (high-water mark)",
         )
+        self._m_worker_restarts = reg.counter(
+            "cobalt_microbatch_worker_restarts_total",
+            "times the watchdog replaced a dead micro-batch worker thread",
+        )
+        self._m_worker_dead = reg.counter(
+            "cobalt_microbatch_worker_dead_total",
+            "queued requests failed with typed worker_dead 500s when the "
+            "worker thread died",
+        )
+        reg.gauge(
+            "cobalt_microbatch_worker_alive",
+            "1 while the micro-batch worker thread is running",
+        ).set_function(lambda: float(self.worker_alive()))
         reg.gauge(
             "cobalt_microbatch_queue_depth",
             "requests currently waiting for a batch slot",
@@ -590,6 +611,9 @@ class MicroBatcher:
         default_device_sampler().add_series(
             "microbatch_queue_depth", self.queue_depth
         )
+        self._start_worker()
+
+    def _start_worker(self) -> None:
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="microbatcher"
         )
@@ -629,6 +653,7 @@ class MicroBatcher:
         them on the request thread — or raises the request's typed error."""
         fut: Future = Future()
         entry = (row, deadline, fut, time.monotonic(), current_request_id())
+        self.ensure_worker()  # a dead worker would strand this entry forever
         with self._cond:
             if self._closed:
                 raise RuntimeError("micro-batcher is closed")
@@ -653,6 +678,52 @@ class MicroBatcher:
     def queue_depth(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    def oldest_queued_age(self) -> float:
+        """Seconds the oldest queued entry has waited (0.0 when empty) — the
+        supervisor's queue-age watchdog signal: a healthy worker drains the
+        head of the queue within one coalescing tick, so a growing head age
+        means the worker is wedged, not busy."""
+        with self._cond:
+            if not self._queue:
+                return 0.0
+            return max(0.0, time.monotonic() - self._queue[0][3])
+
+    def worker_alive(self) -> bool:
+        """True while the worker thread is running (False after `close`)."""
+        return self._thread.is_alive()
+
+    def ensure_worker(self) -> bool:
+        """Watchdog: if the worker thread died (chaos, or a bug escaping the
+        per-batch containment), fail every queued future with a typed
+        `WorkerDead` 500 — a hanging client is worse than a failed one — and
+        start a replacement. Returns True when a restart happened. Called
+        from `submit` and the fleet supervisor's probe loop; the dying
+        worker also calls it from its own unwind, so the gap with no worker
+        is one exception-propagation long."""
+        if self._closed or self._thread.is_alive():
+            return False
+        with self._worker_lock:
+            if self._closed or self._thread.is_alive():
+                return False
+            with self._cond:
+                orphans = list(self._queue)
+                self._queue.clear()
+            for _, _, fut, _, _ in orphans:
+                if not fut.done():
+                    self._m_worker_dead.inc()
+                    fut.set_exception(
+                        WorkerDead("micro-batch worker died with request queued")
+                    )
+            self._m_worker_restarts.inc()
+            _LOG.error(
+                "microbatch_worker_dead",
+                orphaned=len(orphans),
+                restarted=True,
+                detected="watchdog",
+            )
+            self._start_worker()
+            return True
 
     @contextlib.contextmanager
     def pause(self):
@@ -697,6 +768,8 @@ class MicroBatcher:
             "max_batch_rows": self.max_batch_rows,
             "expired_in_queue": self.expired_in_queue,
             "queued": self.queue_depth(),
+            "worker_alive": self.worker_alive(),
+            "worker_restarts": int(self._m_worker_restarts.value),
         }
 
     # -- worker ----------------------------------------------------------------
@@ -724,17 +797,59 @@ class MicroBatcher:
             return batch
 
     def _run(self) -> None:
-        while True:
-            batch = self._collect()
-            if batch is None:
-                return
-            with self._dispatch_lock:
-                try:
-                    self._dispatch(batch)
-                except BaseException as exc:  # the worker must never die
-                    for _, _, fut, _, _ in batch:
-                        if not fut.done():
-                            fut.set_exception(exc)
+        batch: list = []
+        try:
+            while True:
+                batch = self._collect()
+                if batch is None:
+                    return
+                chaos = self._chaos
+                with self._dispatch_lock:
+                    try:
+                        if chaos is not None:
+                            # Chaos checkpoint: `ChaosError` fails this batch
+                            # like any dispatch bug; `WorkerKilled` (a
+                            # BaseException) escapes the containment below
+                            # and genuinely kills the thread.
+                            chaos.on_dispatch()
+                        self._dispatch(batch)
+                    except Exception as exc:  # contain batch-level failures
+                        for _, _, fut, _, _ in batch:
+                            if not fut.done():
+                                fut.set_exception(exc)
+                batch = []
+        except BaseException as exc:
+            # The worker is dying with `batch` in hand and the queue intact;
+            # strand no future (a hanging client is worse than a failed one).
+            self._on_worker_death(exc, batch)
+
+    def _on_worker_death(self, exc: BaseException, batch: list) -> None:
+        """Runs on the dying worker's own unwind: fail the in-hand batch and
+        everything still queued with typed `WorkerDead` 500s, then start the
+        replacement worker (unless `close` is what stopped us)."""
+        with self._worker_lock:
+            with self._cond:
+                orphans = batch + self._queue
+                self._queue.clear()
+            for _, _, fut, _, _ in orphans:
+                if not fut.done():
+                    self._m_worker_dead.inc()
+                    fut.set_exception(
+                        WorkerDead(
+                            "micro-batch worker died with request queued "
+                            f"({type(exc).__name__}: {exc})"
+                        )
+                    )
+            self._m_worker_restarts.inc()
+            _LOG.error(
+                "microbatch_worker_dead",
+                error=f"{type(exc).__name__}: {exc}",
+                orphaned=len(orphans),
+                restarted=not self._closed,
+                detected="unwind",
+            )
+            if not self._closed:
+                self._start_worker()
 
     def _dispatch(self, batch: list) -> None:
         model = self._service._model  # ONE snapshot: a batch never mixes models
@@ -1717,10 +1832,19 @@ class ScorerService:
                     fut = None  # closed in the gap: score on the direct path
             if fut is not None:
                 # blocks this thread; raises the request's typed error
-                # (e.g. DeadlineExceeded -> 504)
-                return self._finish_batched(
-                    row, fut.result(), cache_key, cache_model
-                )
+                # (e.g. DeadlineExceeded -> 504). The wait is bounded by the
+                # deadline so a wedged worker turns into a 504 here, not a
+                # thread parked forever (the sync twin of
+                # `await_under_deadline`; the worker still owns the future
+                # and the queued-expiry accounting).
+                if dl is None:
+                    result = fut.result()
+                else:
+                    try:
+                        result = fut.result(timeout=max(0.0, dl.remaining()))
+                    except (FutureTimeout, TimeoutError):
+                        raise dl.exceeded("queued for micro-batch") from None
+                return self._finish_batched(row, result, cache_key, cache_model)
             return self._predict_direct(row, dl, cache_key, cache_model)
 
     def predict_raw(

@@ -1,0 +1,464 @@
+"""Fleet supervision: per-replica health state machines + a healing loop.
+
+The `ReplicaSet` of PR 7 treats every replica as immortal — a crashed,
+wedged, or error-storming replica degrades the fleet forever. This module is
+the SRE-style supervision layer (README "Fleet resilience") that lets the
+fleet *act* on health:
+
+- `ReplicaHealth` — one per replica, the pure state machine::
+
+      healthy -> degraded -> quarantined -> restarting -> healthy
+
+  driven by an error-rate EWMA over routed outcomes. Only replica-*internal*
+  failures count (`replica_internal`): a 422/429/504 is request policy, not
+  replica health. The router reads ``routable`` and ``error_ewma`` on every
+  pick, so an evicted replica gets no traffic and a flaky one gets less —
+  fixing the dead-replica black hole where a fast-failing replica reported
+  zero load and attracted the whole fleet's traffic.
+
+- `FleetSupervisor` — the background healing loop (one daemon thread per
+  fleet, started with the HTTP server like the history sampler; `tick()` is
+  callable directly so fake-clock tests never sleep). Each tick, per
+  replica: revive a dead micro-batch worker (`MicroBatcher.ensure_worker`),
+  quarantine on a stalled queue head (queue-age watchdog) or on consecutive
+  failed deadline-bounded smoke probes, and heal quarantined replicas —
+  drain (bounded), rebuild a fresh `ScorerService` from the
+  currently-published artifact (prewarmed, smoke-checked exactly like a
+  reload candidate), swap it into the routing table, and readmit. Manual
+  quarantines (``POST /admin/quarantine``) are left for the operator; only
+  supervisor-initiated ones auto-heal.
+
+Every transition is logged, traced, and counted (``cobalt_supervisor_*``),
+and surfaced per replica in ``/readyz``. The chaos harness
+(`reliability.chaos.ChaosPlan`) is the test primitive this layer is
+exercised against.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeout
+from typing import TYPE_CHECKING, Callable
+
+import numpy as np
+
+from cobalt_smart_lender_ai_tpu.reliability.deadline import Deadline
+from cobalt_smart_lender_ai_tpu.reliability.errors import (
+    RequestError,
+    WorkerDead,
+)
+from cobalt_smart_lender_ai_tpu.telemetry import default_tracer, get_logger
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard (replicas -> here)
+    from cobalt_smart_lender_ai_tpu.serve.replicas import ReplicaSet
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+__all__ = [
+    "DEGRADED",
+    "HEALTHY",
+    "QUARANTINED",
+    "RESTARTING",
+    "FleetSupervisor",
+    "ReplicaHealth",
+    "replica_internal",
+]
+
+_LOG = get_logger("serve.supervisor")
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+QUARANTINED = "quarantined"
+RESTARTING = "restarting"
+
+#: Numeric encoding for the `cobalt_supervisor_state` gauge.
+STATE_CODES = {HEALTHY: 0, DEGRADED: 1, QUARANTINED: 2, RESTARTING: 3}
+
+
+def replica_internal(exc: BaseException) -> bool:
+    """True when a failure indicts the *replica*, not the request.
+
+    Typed client/policy errors (422 invalid_input, 429 shed, 504 deadline,
+    503 circuit_open, ...) would fail identically on any replica — they
+    never feed the error EWMA and are never hedged. `WorkerDead` is the one
+    typed 500 that IS replica-internal (that replica's worker died), as is
+    any untyped `Exception` escaping a replica. Non-`Exception`
+    `BaseException`s (cancellation, interrupts) are caller-side, not
+    replica-side."""
+    if isinstance(exc, WorkerDead):
+        return True
+    return isinstance(exc, Exception) and not isinstance(exc, RequestError)
+
+
+class ReplicaHealth:
+    """The per-replica state machine. Pure bookkeeping — no threads, no
+    I/O — so fake-clock unit tests drive it directly; the fleet router and
+    the supervisor are the only writers."""
+
+    __slots__ = (
+        "index",
+        "state",
+        "error_ewma",
+        "outcomes",
+        "probe_failures",
+        "quarantines",
+        "reason",
+        "manual",
+        "last_transition_at",
+        "quarantined_at",
+        "_alpha",
+        "_degraded",
+        "_quarantine",
+        "_recover",
+        "_clock",
+    )
+
+    def __init__(
+        self,
+        index: int,
+        *,
+        alpha: float = 0.2,
+        degraded_ewma: float = 0.3,
+        quarantine_ewma: float = 0.6,
+        recover_ewma: float = 0.1,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.index = index
+        self.state = HEALTHY
+        self.error_ewma = 0.0
+        self.outcomes = 0
+        self.probe_failures = 0  # consecutive
+        self.quarantines = 0
+        self.reason: str | None = None
+        self.manual = False
+        self._alpha = float(alpha)
+        self._degraded = float(degraded_ewma)
+        self._quarantine = float(quarantine_ewma)
+        self._recover = float(recover_ewma)
+        self._clock = clock
+        self.last_transition_at = clock()
+        self.quarantined_at: float | None = None
+
+    @property
+    def routable(self) -> bool:
+        """Degraded replicas stay in rotation (penalized, not evicted);
+        quarantined/restarting ones get no traffic at all."""
+        return self.state in (HEALTHY, DEGRADED)
+
+    def to(
+        self, state: str, reason: str, *, manual: bool = False
+    ) -> tuple[str, str]:
+        """Transition unconditionally; returns ``(old, new)`` for the
+        caller to log/count (`ReplicaSet._note_transition`)."""
+        old, self.state = self.state, state
+        self.reason = reason
+        self.last_transition_at = self._clock()
+        if state == QUARANTINED:
+            self.quarantines += 1
+            self.manual = manual
+            self.quarantined_at = self.last_transition_at
+        elif state == HEALTHY:
+            self.error_ewma = 0.0
+            self.probe_failures = 0
+            self.manual = False
+            self.quarantined_at = None
+        return old, state
+
+    def record_outcome(
+        self, ok: bool, *, allow_quarantine: bool
+    ) -> tuple[str, str] | None:
+        """Fold one routed outcome into the EWMA and advance the state
+        machine. ``allow_quarantine`` is False when no supervisor is
+        attached to heal a quarantined replica — the machine then tops out
+        at degraded and the router penalty does the shielding."""
+        self.outcomes += 1
+        self.error_ewma = (
+            self._alpha * (0.0 if ok else 1.0)
+            + (1.0 - self._alpha) * self.error_ewma
+        )
+        if self.state == HEALTHY and self.error_ewma >= self._degraded:
+            return self.to(
+                DEGRADED, f"error EWMA {self.error_ewma:.2f} over threshold"
+            )
+        if self.state == DEGRADED:
+            if allow_quarantine and self.error_ewma >= self._quarantine:
+                return self.to(
+                    QUARANTINED,
+                    f"error EWMA {self.error_ewma:.2f} over quarantine "
+                    "threshold",
+                )
+            if self.error_ewma <= self._recover:
+                return self.to(HEALTHY, "error EWMA recovered")
+        return None
+
+    def snapshot(self) -> dict:
+        """The ``/readyz`` per-replica drill-down block."""
+        return {
+            "state": self.state,
+            "error_ewma": round(self.error_ewma, 4),
+            "outcomes": self.outcomes,
+            "probe_failures": self.probe_failures,
+            "quarantines": self.quarantines,
+            "reason": self.reason,
+            "manual": self.manual,
+            "since_transition_s": round(
+                max(0.0, self._clock() - self.last_transition_at), 3
+            ),
+        }
+
+
+class FleetSupervisor:
+    """The healing loop over a `ReplicaSet`.
+
+    Construction registers the ``cobalt_supervisor_*`` probe/rebuild/heal
+    families on the fleet registry and wires nothing else — the thread only
+    starts via `start()` (the adapters call `ReplicaSet.start_supervisor`
+    when their socket opens, mirroring the history sampler), and `tick()`
+    runs one full pass synchronously for tests and for the loop."""
+
+    def __init__(
+        self,
+        fleet: "ReplicaSet",
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.fleet = fleet
+        self.config = fleet.config
+        self._clock = clock
+        self._sleep = sleep
+        self._stop_evt = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._heal_lock = threading.Lock()  # one heal at a time per fleet
+        reg = fleet.registry
+        self._m_ticks = reg.counter(
+            "cobalt_supervisor_ticks_total",
+            "supervision passes run over the fleet",
+        )
+        self._m_probes = reg.counter(
+            "cobalt_supervisor_probes_total",
+            "deadline-bounded smoke probes by replica and outcome",
+            ("replica", "outcome"),
+        )
+        self._m_rebuilds = reg.counter(
+            "cobalt_supervisor_rebuilds_total",
+            "quarantined-replica rebuilds by replica and outcome",
+            ("replica", "outcome"),
+        )
+        self._m_heal_s = reg.gauge(
+            "cobalt_supervisor_heal_seconds",
+            "duration of each replica's last quarantine -> healthy cycle",
+            ("replica",),
+        )
+
+    # -- lifecycle -------------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> None:
+        """Start the probe loop (idempotent)."""
+        if self.running:
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="fleet-supervisor"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        interval = max(0.05, float(self.config.supervisor_probe_interval_s))
+        while not self._stop_evt.wait(interval):
+            try:
+                self.tick()
+            except Exception as exc:  # the supervisor must outlive its fleet's bugs
+                _LOG.error("supervisor_tick_failed", error=f"{type(exc).__name__}: {exc}")
+
+    # -- one supervision pass --------------------------------------------------
+
+    def tick(self) -> dict:
+        """One pass over every replica: revive dead workers, watch queue
+        age, probe, quarantine, heal. Returns a summary dict (tests and
+        `status()` read it)."""
+        self._m_ticks.inc()
+        fleet = self.fleet
+        cfg = self.config
+        summary = {"probed": 0, "quarantined": 0, "healed": 0, "revived": 0}
+        for i in range(len(fleet.replicas)):
+            h = fleet.replica_health[i]
+            if h.state == RESTARTING:
+                continue
+            if h.state == QUARANTINED:
+                # Manual quarantines belong to the operator; supervisor-
+                # initiated ones heal automatically.
+                if not h.manual and self.heal(i).get("status") == "healed":
+                    summary["healed"] += 1
+                continue
+            rep = fleet.replicas[i]
+            batcher = rep.batcher
+            if batcher is not None and not batcher.closed:
+                # Worker liveness: a dead worker is revived here even with
+                # zero traffic (submit-side revival needs a submitter).
+                if batcher.ensure_worker():
+                    summary["revived"] += 1
+                age = batcher.oldest_queued_age()
+                if age > cfg.supervisor_queue_age_limit_s:
+                    self.quarantine(
+                        i, f"queue head stalled for {age:.1f}s (wedged worker)"
+                    )
+                    summary["quarantined"] += 1
+                    continue
+            summary["probed"] += 1
+            if self._probe(i, rep):
+                h.probe_failures = 0
+                self._m_probes.labels(replica=str(i), outcome="ok").inc()
+            else:
+                h.probe_failures += 1
+                self._m_probes.labels(replica=str(i), outcome="failed").inc()
+                if h.probe_failures >= cfg.supervisor_probe_failures:
+                    self.quarantine(
+                        i,
+                        f"{h.probe_failures} consecutive smoke probes failed",
+                    )
+                    summary["quarantined"] += 1
+        return summary
+
+    def _probe(self, i: int, rep: "ScorerService") -> bool:
+        """Deadline-bounded smoke probe: score the zeros row through the
+        replica's own batcher path (the same row `_smoke_check` gates
+        reloads with), so a wedged or lying worker fails the probe instead
+        of hiding behind a healthy direct path."""
+        cfg = self.config
+        budget = max(0.05, float(cfg.supervisor_probe_deadline_s))
+        dl = Deadline(budget, self._clock)
+        row = {name: 0.0 for name in rep.feature_names}
+        try:
+            batcher = rep.batcher
+            with default_tracer().span("supervisor.probe", replica=i):
+                if batcher is not None and not batcher.closed:
+                    prob = batcher.submit(row, dl).result(timeout=budget)[0]
+                else:
+                    import jax
+
+                    x = np.zeros((1, len(rep.feature_names)), np.float32)
+                    prob = float(jax.nn.sigmoid(rep._model.margin_fn(x))[0])
+            if not (math.isfinite(prob) and 0.0 <= prob <= 1.0):
+                raise RuntimeError(f"probe scored non-probability {prob!r}")
+            return True
+        except (Exception, FutureTimeout) as exc:
+            _LOG.warning(
+                "supervisor_probe_failed",
+                replica=i,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            return False
+
+    # -- quarantine / heal -----------------------------------------------------
+
+    def quarantine(self, i: int, reason: str, *, manual: bool = False) -> dict:
+        """Evict replica ``i`` from routing (idempotent). Automatic
+        quarantines heal on a later tick; manual ones wait for
+        ``POST /admin/readmit``."""
+        h = self.fleet.replica_health[i]
+        if h.state in (QUARANTINED, RESTARTING):
+            return {"status": h.state, "replica": i, "reason": h.reason}
+        self.fleet._note_transition(i, *h.to(QUARANTINED, reason, manual=manual))
+        return {"status": QUARANTINED, "replica": i, "reason": reason}
+
+    def heal(self, i: int) -> dict:
+        """Drain -> rebuild -> smoke-check -> swap -> readmit replica ``i``.
+
+        The replacement is a fresh `ScorerService` compiled from the
+        fleet's currently-published artifact on the old replica's device,
+        prewarmed per config and smoke-checked exactly like a reload
+        candidate. The old replica is closed on a reaper thread — a wedged
+        worker's join must never stall the heal. A failed rebuild leaves
+        the replica quarantined for the next tick to retry."""
+        fleet = self.fleet
+        h = fleet.replica_health[i]
+        with self._heal_lock:
+            if h.state != QUARANTINED:
+                return {"status": h.state, "replica": i}
+            started = h.quarantined_at or self._clock()
+            fleet._note_transition(i, *h.to(RESTARTING, "rebuilding replacement"))
+            old = fleet.replicas[i]
+            drained = self._drain(i)
+            try:
+                with default_tracer().span("supervisor.rebuild", replica=i):
+                    replacement = self._rebuild(old)
+            except Exception as exc:
+                self._m_rebuilds.labels(replica=str(i), outcome="failed").inc()
+                fleet._note_transition(
+                    i,
+                    *h.to(
+                        QUARANTINED,
+                        f"rebuild failed: {type(exc).__name__}: {exc}",
+                    ),
+                )
+                return {"status": "rebuild_failed", "replica": i}
+            fleet._swap_replica(i, replacement)
+            threading.Thread(
+                target=old.close, daemon=True, name=f"replica-reaper-{i}"
+            ).start()
+            self._m_rebuilds.labels(replica=str(i), outcome="ok").inc()
+            heal_s = max(0.0, self._clock() - started)
+            self._m_heal_s.labels(replica=str(i)).set(heal_s)
+            fleet._note_transition(
+                i, *h.to(HEALTHY, f"rebuilt and readmitted in {heal_s:.2f}s")
+            )
+            _LOG.info(
+                "replica_healed", replica=i, heal_s=round(heal_s, 3),
+                drained=drained,
+            )
+            return {"status": "healed", "replica": i, "heal_s": heal_s}
+
+    def _drain(self, i: int) -> bool:
+        """Bounded wait for replica ``i``'s routed in-flight count to reach
+        zero — it gets no new traffic once quarantined, so this is only
+        waiting out stragglers. Returns False on timeout (the swap proceeds
+        anyway; stragglers finish against the old replica object, which
+        stays alive until its reaper close)."""
+        fleet = self.fleet
+        timeout = max(0.0, float(self.config.supervisor_drain_timeout_s))
+        give_up = self._clock() + timeout
+        while True:
+            with fleet._route_lock:
+                if fleet._inflight[i] == 0:
+                    return True
+            if self._clock() >= give_up:
+                return False
+            self._sleep(0.05)
+
+    def _rebuild(self, old: "ScorerService") -> "ScorerService":
+        from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+        fleet = self.fleet
+        replacement = ScorerService(
+            fleet.artifact,
+            fleet.config,
+            store=old._store,
+            clock=fleet._clock,
+            device=old._device,
+        )
+        replacement._model_key = fleet._model_key
+        # The same gate a reload candidate passes: feature-name agreement
+        # plus a finite in-[0,1] zeros-row score on the freshly compiled
+        # programs.
+        replacement._smoke_check(replacement._model)
+        return replacement
+
+    def status(self) -> dict:
+        """The ``/readyz`` top-level ``supervisor`` block."""
+        return {
+            "enabled": True,
+            "running": self.running,
+            "probe_interval_s": self.config.supervisor_probe_interval_s,
+            "states": [h.state for h in self.fleet.replica_health],
+        }

@@ -132,6 +132,21 @@ def _from_serve_async(record: dict, metrics: dict) -> None:
             _put(metrics, f"{base}.p999_ms", row.get("p99.9_ms"))
 
 
+def _from_serve_chaos(record: dict, metrics: dict) -> None:
+    """BENCH_CHAOS / bench_serve --chaos: the self-healing fleet under
+    injected replica kills and hangs. ``qps`` and ``p999`` auto-gate by
+    name shape against their own chaos baseline; errors / untyped / heal
+    seconds ride along tracked-only (the hard ``== 0`` and ``< 30s`` gates
+    live in the chaos-fleet CI job, which reads the record directly)."""
+    load = record.get("load") or {}
+    _put(metrics, "serve.chaos.qps", load.get("qps"))
+    _put(metrics, "serve.chaos.p999_ms", load.get("p99.9_ms"))
+    _put(metrics, "serve.chaos.errors", load.get("errors"))
+    _put(metrics, "serve.chaos.untyped", load.get("untyped_errors"))
+    sup = record.get("supervisor") or {}
+    _put(metrics, "serve.chaos.heal_s", sup.get("heal_s"))
+
+
 def _from_bulk(record: dict, metrics: dict) -> None:
     """BENCH_BULK_r01 / bench_serve --bulk: best shard plan throughput."""
     best = None
@@ -221,6 +236,8 @@ def extract_metrics(record: dict) -> dict[str, float]:
         _from_serve_throughput(record, metrics)
     elif bench == "serve_async_http":
         _from_serve_async(record, metrics)
+    elif bench == "serve_chaos":
+        _from_serve_chaos(record, metrics)
     elif bench == "bulk_scoring":
         _from_bulk(record, metrics)
     elif bench == "search_halving_vs_exhaustive":

@@ -210,6 +210,8 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
         "/admin/reload",
         "/admin/promote",
         "/admin/rollback",
+        "/admin/quarantine",
+        "/admin/readmit",
     }
     assert set(app.get_routes) == {
         "/healthz",

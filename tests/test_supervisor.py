@@ -1,0 +1,642 @@
+"""Self-healing fleet: replica supervision, quarantine/restart, hedged
+failover, and the chaos harness (`reliability.chaos`).
+
+Pins the PR's guarantees:
+
+- `ReplicaHealth` is a pure fake-clock state machine: EWMA thresholds drive
+  healthy -> degraded -> quarantined, recovery drops back to healthy, and
+  without a supervisor to heal (``allow_quarantine=False``) the machine tops
+  out at degraded;
+- the dead-replica black hole is fixed: an error-storming replica is
+  penalized, then quarantined, and does NOT capture the fleet's traffic —
+  every request still succeeds (hedged failover rescues the ones that
+  landed on it first);
+- hedged failover retries exactly once, on a different replica, only for
+  replica-*internal* failures, and never with an exhausted deadline;
+- the micro-batch worker watchdog turns a killed worker thread into typed
+  500 ``worker_dead`` futures (zero lost requests), restarts the worker,
+  and surfaces ``worker_alive`` in `stats()` / ``/readyz``;
+- the supervisor quarantines on failed deadline-bounded probes (a
+  chaos-hung worker) and heals: drain -> rebuild -> smoke-check -> swap ->
+  readmit, all observable via ``tick()`` summaries and metrics;
+- `ReplicaSet.close()` stays bounded with a chaos-wedged replica;
+- the manual admin plane (``POST /admin/quarantine`` / ``/admin/readmit``)
+  works over live HTTP, shows up in ``/readyz`` drill-down, and diverts
+  traffic;
+- live heal under concurrent HTTP load: chaos kills + error-storms one
+  replica mid-run, clients see zero untyped 500s, and the fleet returns to
+  all-healthy without operator action — while the same scenario with
+  supervision and hedging OFF demonstrably degrades.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cobalt_smart_lender_ai_tpu.config import ServeConfig
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.reliability import (
+    ChaosError,
+    ChaosPlan,
+    WorkerDead,
+)
+from cobalt_smart_lender_ai_tpu.reliability.deadline import Deadline
+from cobalt_smart_lender_ai_tpu.reliability.errors import (
+    DeadlineExceeded,
+    RequestShed,
+    ValidationError,
+)
+from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
+from cobalt_smart_lender_ai_tpu.serve.replicas import ReplicaSet
+from cobalt_smart_lender_ai_tpu.serve.service import (
+    SINGLE_INPUT_FIELDS,
+    ScorerService,
+)
+from cobalt_smart_lender_ai_tpu.serve.supervisor import (
+    DEGRADED,
+    HEALTHY,
+    QUARANTINED,
+    ReplicaHealth,
+    replica_internal,
+)
+
+
+def _cfg(**kw) -> ServeConfig:
+    """Fleet config tuned for fast tests: no prewarm, no score cache (chaos
+    tests count real dispatches), snappy supervisor knobs."""
+    base = dict(
+        replicas=3,
+        microbatch_enabled=False,
+        precompile_batch_buckets=(),
+        prewarm_all_buckets=False,
+        score_cache_size=0,
+        supervisor_probe_deadline_s=0.3,
+        supervisor_probe_failures=1,
+        supervisor_drain_timeout_s=1.0,
+        replica_close_timeout_s=2.0,
+    )
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _payload() -> dict:
+    return {
+        canonical: 1 if canonical in schema.SERVING_INT_FEATURES else 1.5
+        for canonical in SINGLE_INPUT_FIELDS.values()
+    }
+
+
+def _routed_counts(fleet: ReplicaSet) -> list[int]:
+    return [
+        int(fleet._m_routed.labels(replica=str(i)).value)
+        for i in range(len(fleet.replicas))
+    ]
+
+
+def _hedge_counts(fleet: ReplicaSet) -> dict:
+    return {
+        o: int(fleet._m_hedges.labels(outcome=o).value)
+        for o in ("rescued", "failed")
+    }
+
+
+class _FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@contextlib.contextmanager
+def _serving(service):
+    server = make_async_server(service)
+    try:
+        yield f"http://127.0.0.1:{server.port}"
+    finally:
+        server.close()
+
+
+def _request(url, data=None, headers=None):
+    req = urllib.request.Request(
+        url, data=data, method="POST" if data is not None else "GET"
+    )
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+# --- ReplicaHealth: the pure state machine (fake clock, no fleet) -------------
+
+
+def test_replica_internal_classification():
+    """Only failures that indict the replica feed the EWMA / hedging: typed
+    client-policy errors and caller-side BaseExceptions never do."""
+    assert replica_internal(WorkerDead("worker died"))
+    assert replica_internal(RuntimeError("boom"))
+    assert replica_internal(ChaosError("injected"))
+    assert not replica_internal(ValidationError("bad field"))
+    assert not replica_internal(DeadlineExceeded("too slow"))
+    assert not replica_internal(RequestShed("shed"))
+    assert not replica_internal(KeyboardInterrupt())
+
+
+def test_ewma_walk_healthy_degraded_quarantined():
+    """Defaults (alpha=.2): failure EWMA is 1-0.8^n, so degraded lands on
+    the 2nd consecutive failure (.36 >= .3) and quarantine on the 5th
+    (.67 >= .6)."""
+    clock = _FakeClock()
+    h = ReplicaHealth(0, clock=clock)
+    assert h.state == HEALTHY and h.routable
+
+    assert h.record_outcome(False, allow_quarantine=True) is None  # .2
+    t = h.record_outcome(False, allow_quarantine=True)  # .36
+    assert t == (HEALTHY, DEGRADED)
+    assert h.routable  # degraded stays in rotation, penalized
+    for _ in range(2):  # .488, .59 — still degraded
+        assert h.record_outcome(False, allow_quarantine=True) is None
+    t = h.record_outcome(False, allow_quarantine=True)  # .67
+    assert t == (DEGRADED, QUARANTINED)
+    assert not h.routable
+    assert h.quarantines == 1
+    assert h.quarantined_at == clock.t
+
+
+def test_ewma_recovery_resets_to_healthy():
+    clock = _FakeClock()
+    h = ReplicaHealth(1, clock=clock)
+    for _ in range(2):
+        h.record_outcome(False, allow_quarantine=True)
+    assert h.state == DEGRADED
+    transitions = [
+        h.record_outcome(True, allow_quarantine=True) for _ in range(8)
+    ]
+    assert (DEGRADED, HEALTHY) in [t for t in transitions if t]
+    assert h.state == HEALTHY
+    assert h.error_ewma == 0.0  # readmission wipes the slate
+
+
+def test_without_supervisor_tops_out_at_degraded():
+    """No supervisor -> nobody to heal a quarantined replica -> the machine
+    must never evict; the router penalty does the shielding instead."""
+    h = ReplicaHealth(0, clock=_FakeClock())
+    for _ in range(50):
+        h.record_outcome(False, allow_quarantine=False)
+    assert h.state == DEGRADED
+    assert h.routable
+
+
+def test_snapshot_uses_injected_clock():
+    clock = _FakeClock()
+    h = ReplicaHealth(2, clock=clock)
+    h.to(QUARANTINED, "operator says so", manual=True)
+    clock.advance(3.5)
+    snap = h.snapshot()
+    assert snap["state"] == QUARANTINED
+    assert snap["manual"] is True
+    assert snap["reason"] == "operator says so"
+    assert snap["since_transition_s"] == 3.5
+
+
+# --- router: the dead-replica black hole fix ----------------------------------
+
+
+def test_error_storming_replica_does_not_capture_fleet(serving_artifact):
+    """THE regression this PR exists for: a replica failing instantly used
+    to report zero load and win every least-loaded pick. Now its EWMA
+    penalty sheds traffic, auto-quarantine evicts it, and hedged failover
+    rescues the requests that hit it first — the client sees zero errors."""
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(store, _cfg())
+    try:
+
+        def _boom(payload, deadline=None):
+            raise RuntimeError("injected storm")
+
+        fleet.replicas[0].predict_single = _boom
+        payload = _payload()
+        for _ in range(30):  # no exception may escape
+            resp = fleet.predict_single(payload)
+            assert 0.0 <= resp["prob_default"] <= 1.0
+        # the one storm that landed was hedged elsewhere...
+        assert _hedge_counts(fleet)["rescued"] >= 1
+        # ...and the EWMA penalty shed the rest of the traffic: on the old
+        # least-loaded router the instantly-failing replica reported ZERO
+        # load and won every pick (0 routed to the healthy pair)
+        counts = _routed_counts(fleet)
+        assert counts[0] <= 3
+        assert counts[1] + counts[2] >= 30
+        assert fleet.replica_health[0].error_ewma > 0.0
+    finally:
+        fleet.close()
+
+
+def test_manual_quarantine_diverts_traffic_and_readmit_restores(
+    serving_artifact,
+):
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(store, _cfg())
+    try:
+        result = fleet.quarantine_replica(1, reason="operator drill")
+        assert result["status"] == "quarantined"
+        assert fleet.replica_health[1].manual is True
+        # the supervisor must leave manual quarantines to the operator
+        summary = fleet.supervisor.tick()
+        assert summary["healed"] == 0
+        assert fleet.replica_health[1].state == QUARANTINED
+
+        before = _routed_counts(fleet)
+        for _ in range(10):
+            fleet.predict_single(_payload())
+        after = _routed_counts(fleet)
+        assert after[1] == before[1]
+
+        ok, payload = fleet.ready()
+        assert ok  # a healing fleet still serves
+        assert payload["router"]["routable"] == [True, False, True]
+        assert payload["per_replica"][1]["supervisor"]["state"] == QUARANTINED
+
+        assert fleet.readmit_replica(1)["status"] == "readmitted"
+        before = _routed_counts(fleet)
+        for _ in range(9):
+            fleet.predict_single(_payload())
+        assert _routed_counts(fleet)[1] > before[1]
+    finally:
+        fleet.close()
+
+
+def test_quarantine_refuses_to_darken_the_fleet(serving_artifact):
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(store, _cfg(replicas=2))
+    try:
+        fleet.quarantine_replica(0)
+        with pytest.raises(ValidationError):
+            fleet.quarantine_replica(1)  # last routable replica
+        with pytest.raises(ValidationError):
+            fleet.quarantine_replica(99)  # out of range
+        with pytest.raises(ValidationError):
+            fleet.readmit_replica(1)  # healthy, nothing to readmit
+    finally:
+        fleet.close()
+
+
+# --- hedged failover ----------------------------------------------------------
+
+
+def test_hedge_target_decision_table(serving_artifact):
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(store, _cfg(replicas=2))
+    try:
+        assert fleet._hedge_target(RuntimeError("x"), None, 0) == (0,)
+        assert fleet._hedge_target(RuntimeError("x"), Deadline(5.0), 0) == (0,)
+        # typed policy errors fail identically anywhere: never hedge
+        assert fleet._hedge_target(ValidationError("x"), None, 0) is None
+        assert fleet._hedge_target(DeadlineExceeded("x"), None, 0) is None
+        assert fleet._hedge_target(RequestShed("x"), None, 0) is None
+        # an exhausted deadline must never be violated by a hedge
+        assert fleet._hedge_target(RuntimeError("x"), Deadline(0.0), 0) is None
+        # unknown failed index (the failure predates a pick)
+        assert fleet._hedge_target(RuntimeError("x"), None, None) is None
+    finally:
+        fleet.close()
+
+
+def test_hedged_failover_rescues_on_internal_error(serving_artifact):
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(store, _cfg(replicas=2))
+    try:
+
+        def _boom(payload, deadline=None):
+            raise RuntimeError("replica-internal fault")
+
+        fleet.replicas[0].predict_single = _boom
+        fleet._rr = 0  # force the next pick onto the poisoned replica
+        resp = fleet.predict_single(_payload())
+        assert 0.0 <= resp["prob_default"] <= 1.0
+        counts = _hedge_counts(fleet)
+        assert counts["rescued"] == 1 and counts["failed"] == 0
+        assert _routed_counts(fleet) == [1, 1]  # one failed try, one rescue
+    finally:
+        fleet.close()
+
+
+def test_no_hedge_on_typed_client_errors(serving_artifact):
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(store, _cfg(replicas=2))
+    try:
+        before = _hedge_counts(fleet)
+        with pytest.raises(ValidationError):
+            fleet.predict_single({"loan_amnt": "not-a-number"})
+        with pytest.raises(DeadlineExceeded):
+            fleet.predict_single(_payload(), deadline=Deadline(0.0))
+        assert _hedge_counts(fleet) == before
+        # typed errors never feed the health EWMA either
+        assert all(h.error_ewma == 0.0 for h in fleet.replica_health)
+    finally:
+        fleet.close()
+
+
+# --- micro-batch worker watchdog ----------------------------------------------
+
+
+def test_worker_death_resolves_futures_typed_and_restarts(serving_artifact):
+    """A chaos-killed worker must (a) fail every queued future with the
+    typed 500 ``worker_dead`` — zero lost requests — and (b) restart itself
+    so the next request scores normally."""
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(
+        store,
+        _cfg(replicas=1, microbatch_enabled=True, microbatch_max_wait_ms=1.0),
+    )
+    plan = ChaosPlan(seed=1).kill_worker(replica=0)
+    try:
+        plan.inject(svc)
+        row = {name: 0.0 for name in svc.feature_names}
+        with svc.batcher.pause():  # coalesce three rows into the doomed batch
+            futs = [svc.batcher.submit(row, None) for _ in range(3)]
+        for fut in futs:
+            with pytest.raises(WorkerDead) as ei:
+                fut.result(timeout=10.0)
+            assert ei.value.status == 500
+            assert ei.value.body()["error"] == "worker_dead"
+
+        deadline = time.monotonic() + 10.0
+        while not svc.batcher.worker_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        stats = svc.batcher.stats()
+        assert stats["worker_alive"] is True
+        assert stats["worker_restarts"] >= 1
+        resp = svc.predict_single(_payload())  # the revived worker serves
+        assert 0.0 <= resp["prob_default"] <= 1.0
+        ok, ready = svc.ready()
+        assert ok and ready["microbatch"]["worker_alive"] is True
+    finally:
+        plan.release()
+        svc.close()
+
+
+def test_ensure_worker_revives_a_dead_thread(serving_artifact):
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(
+        store,
+        _cfg(replicas=1, microbatch_enabled=True, microbatch_max_wait_ms=1.0),
+    )
+    try:
+        assert svc.batcher.ensure_worker() is False  # alive -> no-op
+        dead = threading.Thread(target=lambda: None)
+        dead.start()
+        dead.join()
+        svc.batcher._thread = dead
+        assert svc.batcher.worker_alive() is False
+        assert svc.batcher.ensure_worker() is True
+        assert svc.batcher.worker_alive() is True
+        resp = svc.predict_single(_payload())
+        assert 0.0 <= resp["prob_default"] <= 1.0
+    finally:
+        svc.close()
+
+
+# --- the supervisor: probe -> quarantine -> heal ------------------------------
+
+
+def test_probe_quarantines_hung_replica_and_heals(serving_artifact):
+    """A chaos-hung worker wedges dispatch: the deadline-bounded probe times
+    out, the supervisor quarantines, and the next tick heals — fresh
+    replica compiled from the published artifact, swapped into the routing
+    slot, readmitted. All driven via tick(), no background thread."""
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(
+        store, _cfg(replicas=2, microbatch_enabled=True, microbatch_max_wait_ms=1.0)
+    )
+    plan = ChaosPlan(seed=2).hang_dispatch(replica=1, hang_s=60.0, max_events=1)
+    try:
+        plan.inject(fleet)
+        old = fleet.replicas[1]
+        summary = fleet.supervisor.tick()
+        assert summary["quarantined"] == 1
+        assert fleet.replica_health[1].state == QUARANTINED
+        assert fleet.replica_health[1].manual is False
+
+        summary = fleet.supervisor.tick()
+        assert summary["healed"] == 1
+        assert fleet.replica_health[1].state == HEALTHY
+        assert fleet.replicas[1] is not old  # genuinely rebuilt, not readmitted
+        heal_s = fleet.supervisor._m_heal_s.labels(replica="1").value
+        assert heal_s >= 0.0
+        rebuilt = fleet.supervisor._m_rebuilds.labels(
+            replica="1", outcome="ok"
+        ).value
+        assert rebuilt == 1
+
+        summary = fleet.supervisor.tick()  # the rebuilt replica passes probes
+        assert summary["probed"] == 2 and summary["quarantined"] == 0
+        for _ in range(6):  # and serves traffic
+            resp = fleet.predict_single(_payload())
+            assert 0.0 <= resp["prob_default"] <= 1.0
+    finally:
+        plan.release()  # un-wedge the reaped worker so close stays quick
+        fleet.close()
+
+
+def test_fleet_close_bounded_with_wedged_replica(serving_artifact):
+    """One chaos-hung worker must not stall fleet shutdown: replicas close
+    concurrently and stragglers are abandoned at the bound."""
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(
+        store,
+        _cfg(
+            replicas=2,
+            microbatch_enabled=True,
+            microbatch_max_wait_ms=1.0,
+            replica_close_timeout_s=1.0,
+        ),
+    )
+    plan = ChaosPlan(seed=3).hang_dispatch(replica=1, hang_s=60.0, max_events=1)
+    plan.inject(fleet)
+    try:
+        row = {name: 0.0 for name in fleet.feature_names}
+        fleet.replicas[1].batcher.submit(row, None)  # wedge the worker
+        give_up = time.monotonic() + 5.0
+        while plan.events.get("hang", 0) == 0 and time.monotonic() < give_up:
+            time.sleep(0.01)
+        assert plan.events.get("hang", 0) == 1
+
+        t0 = time.monotonic()
+        fleet.close()
+        assert time.monotonic() - t0 < 8.0  # bounded, not the 60s hang
+    finally:
+        plan.release()
+
+
+# --- manual admin plane over live HTTP ----------------------------------------
+
+
+def test_admin_quarantine_readmit_http(serving_artifact):
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(store, _cfg())
+    with _serving(fleet) as url:
+        status, body, _ = _request(
+            f"{url}/admin/quarantine",
+            json.dumps({"replica": 1, "reason": "drill"}).encode(),
+        )
+        assert status == 200
+        out = json.loads(body)
+        assert out["status"] == "quarantined" and out["replica"] == 1
+
+        status, body, _ = _request(f"{url}/readyz")
+        assert status == 200
+        ready = json.loads(body)
+        assert ready["router"]["routable"] == [True, False, True]
+        assert ready["per_replica"][1]["supervisor"]["state"] == QUARANTINED
+        assert ready["per_replica"][1]["supervisor"]["manual"] is True
+        assert ready["supervisor"]["states"][1] == QUARANTINED
+
+        before = _routed_counts(fleet)
+        payload = json.dumps(_payload()).encode()
+        for _ in range(8):
+            status, _, _ = _request(f"{url}/predict", payload)
+            assert status == 200
+        assert _routed_counts(fleet)[1] == before[1]
+
+        # idempotent repeat, then readmit, then readmit again -> typed 422
+        status, body, _ = _request(
+            f"{url}/admin/quarantine", json.dumps({"replica": 1}).encode()
+        )
+        assert status == 200 and json.loads(body)["status"] == "quarantined"
+        status, body, _ = _request(
+            f"{url}/admin/readmit", json.dumps({"replica": 1}).encode()
+        )
+        assert status == 200 and json.loads(body)["status"] == "readmitted"
+        status, body, _ = _request(
+            f"{url}/admin/readmit", json.dumps({"replica": 1}).encode()
+        )
+        assert status == 422 and json.loads(body)["error"] == "invalid_input"
+        status, body, _ = _request(
+            f"{url}/admin/quarantine", json.dumps({"replica": 99}).encode()
+        )
+        assert status == 422 and json.loads(body)["error"] == "invalid_input"
+    fleet.close()
+
+
+def test_admin_quarantine_on_single_replica_service_is_typed(serving_artifact):
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(store, _cfg(replicas=1))
+    with _serving(svc) as url:
+        status, body, _ = _request(
+            f"{url}/admin/quarantine", json.dumps({"replica": 0}).encode()
+        )
+        assert status == 422
+        assert json.loads(body)["error"] == "invalid_input"
+    svc.close()
+
+
+# --- live heal under load (and the supervision-off contrast) ------------------
+
+
+def _hammer(url: str, n_threads: int, duration_s: float):
+    """Concurrent clients against POST /predict; returns (statuses, bodies)
+    of every response observed."""
+    payload = json.dumps(_payload()).encode()
+    results: list[tuple[int, bytes]] = []
+    lock = threading.Lock()
+    stop_at = time.monotonic() + duration_s
+
+    def client():
+        while time.monotonic() < stop_at:
+            status, body, _ = _request(f"{url}/predict", payload)
+            with lock:
+                results.append((status, body))
+
+    threads = [threading.Thread(target=client) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def test_live_heal_under_load_zero_untyped_500s(serving_artifact):
+    """The chaos heal demo: kill + error-storm one replica of three while
+    concurrent HTTP clients hammer the fleet. Supervision + hedging must
+    keep every response typed (zero untyped 500s) and return the fleet to
+    all-healthy without operator action."""
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(
+        store,
+        _cfg(
+            microbatch_enabled=True,
+            microbatch_max_wait_ms=1.0,
+            supervisor_probe_interval_s=0.15,
+        ),
+    )
+    plan = ChaosPlan(seed=4)
+    with _serving(fleet) as url:  # start_async starts the supervisor thread
+        assert fleet.supervisor.running
+        plan.inject(fleet)
+        plan.kill_worker(replica=1, max_events=1)
+        plan.error_storm(replica=1, rate=1.0, max_events=12)
+
+        results = _hammer(url, n_threads=6, duration_s=3.0)
+        assert len(results) > 50
+
+        for status, body in results:
+            if status != 200:
+                out = json.loads(body)
+                assert "error" in out, f"untyped {status}: {body!r}"
+                assert status != 500 or out["error"] == "worker_dead"
+
+        # the fleet self-heals: every replica back to healthy, no operator
+        give_up = time.monotonic() + 25.0
+        while time.monotonic() < give_up:
+            if all(h.state == HEALTHY for h in fleet.replica_health):
+                break
+            time.sleep(0.2)
+        assert all(h.state == HEALTHY for h in fleet.replica_health)
+    plan.release()
+    fleet.close()
+
+
+def test_supervision_off_same_scenario_degrades(serving_artifact):
+    """The control arm: supervision and hedging disabled, same storm. The
+    client-visible failures that the self-healing fleet absorbed now leak —
+    proof the new layer is doing the work, not the scenario being easy."""
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(
+        store, _cfg(replicas=2, supervisor_enabled=False, hedge_enabled=False)
+    )
+    try:
+        assert fleet.supervisor is None
+
+        def _boom(payload, deadline=None):
+            raise RuntimeError("injected storm")
+
+        fleet.replicas[0].predict_single = _boom
+        fleet._rr = 0
+        failures = 0
+        for _ in range(20):
+            try:
+                fleet.predict_single(_payload())
+            except RuntimeError:
+                failures += 1
+        assert failures >= 1  # errors reach the client unhedged
+        # and nothing heals or evicts: the machine tops out at degraded
+        assert fleet.replica_health[0].state in (HEALTHY, DEGRADED)
+        assert _hedge_counts(fleet) == {"rescued": 0, "failed": 0}
+    finally:
+        fleet.close()
