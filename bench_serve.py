@@ -862,6 +862,271 @@ def run_chaos_bench(
     return record
 
 
+def run_traffic_bench(
+    artifact,
+    payloads: list[dict],
+    *,
+    shape_name: str,
+    base_rps: float,
+    peak_rps: float,
+    duration_s: float,
+    seed: int = 0,
+    start_replicas: int = 1,
+    max_replicas: int = 3,
+) -> dict:
+    """The BENCH_TRAFFIC protocol (autoscale-smoke CI job): ONE replica
+    behind the asyncio adapter with the load-adaptive control loop enabled
+    (`serve.autoscaler`), driven by an **open-loop** seeded arrival schedule
+    from `reliability.traffic` — arrivals fire at their scheduled time no
+    matter how slow the server is, so overload is measured, not hidden by
+    client self-throttling. A ``flash_crowd`` run is the headline: the spike
+    must force scale-ups, a sustained fast-burn at the replica ceiling must
+    walk the brownout ladder (``degraded: true`` responses without SHAP),
+    and the decay must release every rung and retire the extra capacity —
+    with zero errors and zero untyped error bodies end to end."""
+    import asyncio
+    import os
+
+    from cobalt_smart_lender_ai_tpu.config import ReliabilityConfig, ServeConfig
+    from cobalt_smart_lender_ai_tpu.data import schema
+    from cobalt_smart_lender_ai_tpu.reliability.traffic import (
+        KIND_BULK,
+        KIND_SHAP,
+        TenantPopulation,
+        TrafficGenerator,
+        shape_by_name,
+    )
+    from cobalt_smart_lender_ai_tpu.serve.replicas import ReplicaSet
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    config = ServeConfig(
+        replicas=start_replicas,
+        microbatch_enabled=True,
+        score_cache_size=0,
+        prewarm_all_buckets=False,
+        # Tight latency objectives + short burn windows: the flash-crowd
+        # plateau must register as a fast burn within the run, and the decay
+        # must clear it before the run ends.
+        slo_p99_ms=25.0,
+        slo_p999_ms=120.0,
+        slo_windows_s=(3.0, 12.0),
+        history_enabled=True,
+        history_interval_s=0.5,
+        history_tiers=((0.5, 720),),
+        supervisor_probe_interval_s=0.5,
+        supervisor_probe_deadline_s=1.0,
+        supervisor_drain_timeout_s=2.0,
+        autoscaler_enabled=True,
+        autoscaler_interval_s=0.25,
+        autoscaler_min_replicas=1,
+        autoscaler_max_replicas=max_replicas,
+        autoscaler_scale_up_cooldown_s=1.0,
+        autoscaler_scale_down_cooldown_s=2.0,
+        autoscaler_stable_ticks=4,
+        autoscaler_queue_wait_high_ms=15.0,
+        autoscaler_queue_wait_low_ms=4.0,
+        # brownout_max_level=3 (the default): the ladder degrades SHAP and
+        # widens coalescing but never sheds, so "errors == 0" stays a hard
+        # gate even at the spike's peak.
+        reliability=ReliabilityConfig(max_in_flight=1024),
+    )
+    fleet = ReplicaSet(
+        [ScorerService(artifact, config) for _ in range(start_replicas)],
+        config,
+    )
+    port, shutdown = _start_bench_server("asyncio", fleet)
+
+    gen = TrafficGenerator(
+        shape_by_name(shape_name, seed),
+        base_rps=base_rps,
+        peak_rps=peak_rps,
+        duration_s=duration_s,
+        tenants=TenantPopulation(
+            list(payloads[0]),
+            # Int-typed wire fields must survive jitter integral or the
+            # validation schema 422s every single-row request.
+            [
+                schema.SERVING_FIELD_ALIASES.get(n, n)
+                for n in schema.SERVING_INT_FEATURES
+            ],
+            base_rows=payloads,
+            jitter=0.03,
+            seed=seed,
+        ),
+        seed=seed,
+    )
+    schedule = gen.schedule()
+    csv_header = ",".join(payloads[0]) + "\n"
+
+    def _body(arrival) -> tuple[str, bytes, str]:
+        if arrival.kind == KIND_BULK:
+            rows = "".join(
+                ",".join(f"{v:g}" for v in arrival.payload.values()) + "\n"
+                for _ in range(gen.bulk_rows)
+            )
+            return (
+                "/predict_bulk_csv",
+                (csv_header + rows).encode(),
+                "text/csv",
+            )
+        if arrival.kind == KIND_SHAP:
+            return (
+                "/feature_importance_bulk",
+                json.dumps({"data": [arrival.payload]}).encode(),
+                "application/json",
+            )
+        return "/predict", json.dumps(arrival.payload).encode(), "application/json"
+
+    n_conns = 64
+    lat: list[float] = []
+    counts = {"requests": 0, "errors": 0, "untyped": 0, "shed": 0,
+              "degraded": 0}
+    by_kind: dict[str, int] = {}
+    timeline: list[dict] = []
+
+    async def sampler(stop_at: float) -> None:
+        # replica-count / brownout-level timeline alongside the load — the
+        # committed record shows the control loop acting, not just its
+        # end-state counters.
+        loop = asyncio.get_running_loop()
+        while loop.time() < stop_at:
+            timeline.append(
+                {
+                    "t": round(time.monotonic() - t0[0], 2),
+                    "replicas": len(fleet.replicas),
+                    "brownout_level": fleet.brownout.level,
+                }
+            )
+            await asyncio.sleep(0.5)
+
+    t0 = [0.0]
+
+    async def fire(arrival, conns: "asyncio.Queue") -> None:
+        await asyncio.sleep(max(0.0, t0[0] + arrival.t - time.monotonic()))
+        t_sched = t0[0] + arrival.t  # latency from *scheduled* fire time:
+        # a backed-up harness queue counts against the server (open loop)
+        path, body, ctype = _body(arrival)
+        req = (
+            f"POST {path} HTTP/1.1\r\nHost: bench\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode() + body
+        reader, writer = await conns.get()
+        try:
+            try:
+                writer.write(req)
+                await writer.drain()
+                status, resp_body = await _read_http_response(reader)
+            except (ConnectionError, asyncio.IncompleteReadError):
+                writer.close()
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port
+                )
+                writer.write(req)
+                await writer.drain()
+                status, resp_body = await _read_http_response(reader)
+            lat.append((time.monotonic() - t_sched) * 1e3)
+            counts["requests"] += 1
+            by_kind[arrival.kind] = by_kind.get(arrival.kind, 0) + 1
+            if status == 200:
+                if arrival.kind == "single" and b'"degraded"' in resp_body:
+                    counts["degraded"] += 1
+            else:
+                try:
+                    typed = "error" in json.loads(resp_body.decode())
+                except Exception:
+                    typed = False
+                if status == 429 and typed:
+                    counts["shed"] += 1  # designed backpressure, not failure
+                else:
+                    counts["errors"] += 1
+                    if not typed:
+                        counts["untyped"] += 1
+        finally:
+            conns.put_nowait((reader, writer))
+
+    async def drive() -> None:
+        conns: asyncio.Queue = asyncio.Queue()
+        for _ in range(n_conns):
+            conns.put_nowait(await asyncio.open_connection("127.0.0.1", port))
+        t0[0] = time.monotonic()
+        # The sampler outlives the arrivals by a quiet settle window — the
+        # idle evidence the control loop needs to release remaining rungs
+        # and retire the surge capacity before the record is cut.
+        settle = max(8.0, duration_s / 3.0)
+        stop_at = asyncio.get_running_loop().time() + duration_s + settle
+        await asyncio.gather(
+            sampler(stop_at), *(fire(a, conns) for a in schedule)
+        )
+        while not conns.empty():
+            _, writer = conns.get_nowait()
+            writer.close()
+
+    print(
+        f"[bench] traffic {shape_name}: {len(schedule)} open-loop arrivals, "
+        f"{base_rps:g}->{peak_rps:g} rps over {duration_s:g}s, "
+        f"{start_replicas}->{max_replicas} replicas available...",
+        file=sys.stderr,
+    )
+    try:
+        asyncio.run(drive())
+    finally:
+        shutdown()
+    scaler = fleet.autoscaler
+    autoscaler_block = {
+        "resizes_up": int(scaler._m_resizes.labels(direction="up").value),
+        "resizes_down": int(scaler._m_resizes.labels(direction="down").value),
+        "retunes_busy": int(scaler._m_retunes.labels(profile="busy").value),
+        "retunes_idle": int(scaler._m_retunes.labels(profile="idle").value),
+        "brownout_engaged": int(
+            scaler._m_brownouts.labels(direction="engage").value
+        ),
+        "brownout_released": int(
+            scaler._m_brownouts.labels(direction="release").value
+        ),
+        "final_level": fleet.brownout.level,
+        "max_level_seen": max(
+            (p["brownout_level"] for p in timeline), default=0
+        ),
+        "final_replicas": len(fleet.replicas),
+        "max_replicas_seen": max(
+            (p["replicas"] for p in timeline), default=start_replicas
+        ),
+        "ticks": int(scaler._m_ticks.value),
+        "timeline": timeline,
+    }
+    fleet.close()
+    singles = sorted(lat)
+    record = {
+        "bench": "serve_traffic",
+        "protocol": "open-loop seeded arrivals against an autoscaled fleet; "
+        "gate errors==0, untyped==0, >=1 scale-up and scale-down, brownout "
+        "engaged and fully released",
+        "traffic": gen.summary(),
+        "start_replicas": start_replicas,
+        "load": {
+            "requests": counts["requests"],
+            "qps": round(counts["requests"] / duration_s, 1),
+            "errors": counts["errors"],
+            "untyped_errors": counts["untyped"],
+            "shed": counts["shed"],
+            "degraded": counts["degraded"],
+            "by_kind": by_kind,
+            "p50_ms": round(_percentile(singles, 0.50), 3),
+            "p95_ms": round(_percentile(singles, 0.95), 3),
+            "p99_ms": round(_percentile(singles, 0.99), 3),
+            "p99.9_ms": round(_percentile(singles, 0.999), 3),
+            "max_ms": round(singles[-1], 3) if singles else float("nan"),
+        },
+        "autoscaler": autoscaler_block,
+        "platform": _platform_tag(),
+        "host_cpu_cores": len(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity")
+        else (os.cpu_count() or 1),
+    }
+    return record
+
+
 def run_bulk_bench(
     artifact,
     X,
@@ -996,6 +1261,24 @@ def main(argv: list[str] | None = None) -> int:
                         "mid-run (the chaos-fleet CI job protocol)")
     parser.add_argument("--chaos-replicas", type=int, default=3,
                         help="fleet size for --chaos")
+    parser.add_argument("--traffic", default=None,
+                        metavar="SHAPE",
+                        help="run the load-adaptive fleet bench: an open-"
+                        "loop seeded arrival schedule of this shape "
+                        "(flash_crowd, diurnal, bursty, ramp, steady) "
+                        "against an autoscaler-enabled fleet (the "
+                        "autoscale-smoke CI job protocol)")
+    parser.add_argument("--traffic-base-rps", type=float, default=8.0,
+                        help="trough arrival rate for --traffic")
+    parser.add_argument("--traffic-peak-rps", type=float, default=600.0,
+                        help="peak arrival rate for --traffic")
+    parser.add_argument("--traffic-duration-s", type=float, default=24.0,
+                        help="arrival-schedule length for --traffic "
+                        "(a settle window is appended on top)")
+    parser.add_argument("--traffic-seed", type=int, default=0,
+                        help="arrival-schedule seed for --traffic")
+    parser.add_argument("--traffic-max-replicas", type=int, default=3,
+                        help="autoscaler replica ceiling for --traffic")
     parser.add_argument("--http-smoke", action="store_true",
                         help="also drive load over real HTTP and scrape "
                         "/metrics during it (validates the telemetry wiring; "
@@ -1161,6 +1444,38 @@ def main(argv: list[str] | None = None) -> int:
             warmup_s=args.warmup_s,
             replicas=args.chaos_replicas,
             mb_kwargs=mb_kwargs,
+        )
+        line = json.dumps(record)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(line + "\n")
+        _write_ledger(record)
+        _write_trend(record)
+        return 0
+
+    if args.traffic:
+        print(f"[bench] training model ({args.rows} synthetic rows)...",
+              file=sys.stderr)
+        service, X = build_service(
+            ServeConfig(microbatch_enabled=False, prewarm_all_buckets=False),
+            n_rows=args.rows,
+        )
+        artifact = service.artifact
+        service.close()
+        payloads = build_payloads(X)
+        if args.smoke:
+            args.traffic_duration_s = min(args.traffic_duration_s, 18.0)
+            args.traffic_peak_rps = min(args.traffic_peak_rps, 400.0)
+        record = run_traffic_bench(
+            artifact,
+            payloads,
+            shape_name=args.traffic,
+            base_rps=args.traffic_base_rps,
+            peak_rps=args.traffic_peak_rps,
+            duration_s=args.traffic_duration_s,
+            seed=args.traffic_seed,
+            max_replicas=args.traffic_max_replicas,
         )
         line = json.dumps(record)
         print(line)

@@ -456,6 +456,53 @@ class ServeConfig:
     drift_bins: int = 10
     drift_psi_alert: float = 0.25
     drift_min_samples: int = 100
+    #: SLO-driven autoscaler (serve.autoscaler, README "Adaptive capacity &
+    #: brownout"): a control loop OFF the request path that reads telemetry
+    #: history (queue-wait quantiles, queue depth) and SLO burn signals and
+    #: resizes the ReplicaSet through the supervisor's machinery — scale-up =
+    #: rebuild-from-artifact + smoke + admit, scale-down = drain + retire.
+    #: Opt-in: a fleet without it behaves exactly as before.
+    autoscaler_enabled: bool = False
+    #: Control-loop cadence (the thread starts with the HTTP server, like
+    #: the supervisor and history sampler).
+    autoscaler_interval_s: float = 1.0
+    #: Fleet size bounds. The floor is also enforced structurally:
+    #: `remove_replica` refuses to drop the last routable replica.
+    autoscaler_min_replicas: int = 1
+    autoscaler_max_replicas: int = 4
+    #: Cooldowns (hysteresis): no scale-up within this many seconds of the
+    #: previous resize, and scale-down only after the fleet has looked idle
+    #: for ``autoscaler_stable_ticks`` consecutive evaluations AND the
+    #: longer scale-down cooldown has passed. Asymmetry is deliberate —
+    #: react fast to overload, retire capacity slowly.
+    autoscaler_scale_up_cooldown_s: float = 5.0
+    autoscaler_scale_down_cooldown_s: float = 15.0
+    autoscaler_stable_ticks: int = 3
+    #: Busy/idle watermarks. "Busy" = SLO fast-burn, or per-replica queue
+    #: wait p95 above the high watermark, or admission in-flight utilization
+    #: above the high fraction. "Idle" = every signal under its low mark.
+    autoscaler_queue_wait_high_ms: float = 20.0
+    autoscaler_queue_wait_low_ms: float = 2.0
+    autoscaler_util_high: float = 0.75
+    autoscaler_util_low: float = 0.25
+    #: Load-dependent micro-batch retune: under sustained load the batcher
+    #: trades latency for throughput (wider coalescing window, bigger
+    #: batches); when load clears the knobs return to the configured
+    #: defaults. Published under the batcher pause gate.
+    autoscaler_retune_enabled: bool = True
+    autoscaler_busy_wait_ms: float = 5.0
+    autoscaler_busy_max_rows: int = 256
+    #: Brownout ladder (serve.autoscaler.BrownoutLadder): when the fleet is
+    #: already at ``autoscaler_max_replicas`` (or inside the scale-up
+    #: cooldown) and the SLO still fast-burns, degrade in a declared order
+    #: instead of falling straight to 429: drop canary shadow taps -> serve
+    #: ``degraded: true`` without SHAP -> widen micro-batch coalescing ->
+    #: shed bulk before single-row -> shed everything. Rungs engage one per
+    #: control tick and release symmetrically as burn clears.
+    #: ``brownout_max_level`` caps how far down the ladder the controller
+    #: may go (2 = never sheds; 4 = bulk 429s; 5 = full 429).
+    brownout_enabled: bool = True
+    brownout_max_level: int = 3
     reliability: ReliabilityConfig = dataclasses.field(
         default_factory=ReliabilityConfig
     )

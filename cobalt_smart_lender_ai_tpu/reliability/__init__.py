@@ -43,6 +43,14 @@ Fleet-level chaos (PR 17 — the supervision layer's test primitive):
   batcher worker, hang dispatch, error-storm, add latency) armed per replica
   index, so `serve.supervisor` heals under real injected failures in tests
   and the `chaos-fleet` CI job.
+
+Synthetic load (PR 18 — the autoscaler's test primitive):
+
+- `traffic` — `TrafficShape` / `TenantPopulation` / `TrafficGenerator`:
+  seeded open-loop arrival schedules (diurnal, bursty, flash-crowd, ramp)
+  over a Zipf-weighted tenant population, so `serve.autoscaler` scales and
+  browns out under realistic load in tests and the `autoscale-smoke` CI
+  job (``bench_serve.py --traffic``).
 """
 
 from cobalt_smart_lender_ai_tpu.reliability.admission import (
@@ -97,6 +105,12 @@ from cobalt_smart_lender_ai_tpu.reliability.stores import (
     CorruptObjectError,
     ResilientStore,
 )
+from cobalt_smart_lender_ai_tpu.reliability.traffic import (
+    TenantPopulation,
+    TrafficGenerator,
+    TrafficShape,
+    shape_by_name,
+)
 
 __all__ = [
     "AdmissionController",
@@ -120,7 +134,10 @@ __all__ = [
     "ResilientStore",
     "RetryPolicy",
     "RollbackFailed",
+    "TenantPopulation",
     "TokenBucket",
+    "TrafficGenerator",
+    "TrafficShape",
     "ValidationError",
     "WorkerDead",
     "WorkerKilled",
@@ -131,6 +148,7 @@ __all__ = [
     "error_response",
     "is_transient_store_error",
     "policy_from_config",
+    "shape_by_name",
     "await_under_deadline",
     "start_deadline",
 ]

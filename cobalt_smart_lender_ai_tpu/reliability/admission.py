@@ -75,6 +75,20 @@ class TokenBucket:
             deficit = n - self._tokens
             return max(0.0, deficit / self.rate_rps)
 
+    def resize(self, rate_rps: float, burst: float) -> None:
+        """Swap the bucket's rate/burst in place (fleet resize). Accrued
+        tokens are refilled at the OLD rate first, then clamped to the new
+        burst — a shrink can't leave a stale oversized balance."""
+        if rate_rps <= 0:
+            raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+        if burst < 1:
+            raise ValueError(f"burst must be >= 1, got {burst}")
+        with self._lock:
+            self._refill_locked()
+            self.rate_rps = float(rate_rps)
+            self.burst = float(burst)
+            self._tokens = min(self._tokens, self.burst)
+
 
 class AdmissionController:
     """Gate every scoring request through ``with admission.admit():``.
@@ -98,11 +112,36 @@ class AdmissionController:
         )
         self.max_in_flight = max_in_flight
         self.shed_retry_after_s = shed_retry_after_s
+        # Per-unit base values for `rescale`: the configured limits describe
+        # what ONE replica can absorb; a fleet multiplies them by its size.
+        self._base_rate_rps = rate_rps
+        self._base_burst = burst
+        self._base_max_in_flight = max_in_flight
+        self.scale_units = 1
         self._lock = threading.Lock()
         self.in_flight = 0
         self.admitted = 0
         self.shed_rate = 0
         self.shed_capacity = 0
+
+    def rescale(self, units: int) -> dict:
+        """Recompute capacity for ``units`` serving replicas: shedding
+        thresholds must track actual capacity, or a scale-up keeps shedding
+        at the old single-replica limits (and a scale-down queues load the
+        shrunken fleet can no longer absorb)."""
+        units = max(1, int(units))
+        self.scale_units = units
+        if self._base_max_in_flight is not None:
+            self.max_in_flight = self._base_max_in_flight * units
+        if self.bucket is not None and self._base_rate_rps is not None:
+            self.bucket.resize(
+                self._base_rate_rps * units, max(1, self._base_burst * units)
+            )
+        return {
+            "units": units,
+            "max_in_flight": self.max_in_flight,
+            "rate_rps": None if self.bucket is None else self.bucket.rate_rps,
+        }
 
     @contextlib.contextmanager
     def admit(self) -> Iterator[None]:
@@ -141,6 +180,8 @@ class AdmissionController:
                 "admitted": self.admitted,
                 "shed_rate": self.shed_rate,
                 "shed_capacity": self.shed_capacity,
+                "max_in_flight": self.max_in_flight,
+                "scale_units": self.scale_units,
             }
 
 

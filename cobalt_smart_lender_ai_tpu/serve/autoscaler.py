@@ -1,0 +1,576 @@
+"""SLO-driven fleet autoscaling + the brownout degradation ladder.
+
+PR 17's supervisor gave the fleet *failure* robustness: a sick replica is
+drained, rebuilt from the published artifact, smoke-checked and swapped back
+in. This module reuses exactly that machinery for *overload* robustness
+(README "Adaptive capacity & brownout"):
+
+- `FleetAutoscaler` — a control loop OFF the request path (one daemon
+  thread, started with the HTTP server like the supervisor; `tick()` is
+  callable directly so fake-clock tests never sleep). Each tick it reads
+  the signals the telemetry stack already measures — SLO fast-burn
+  (`telemetry.slo`), per-replica micro-batch queue-wait quantiles from the
+  fleet history (`telemetry.timeseries`), admission in-flight utilization,
+  live queue depths — and acts through the fleet's existing state machine:
+
+  * **scale-up** = build a fresh `ScorerService` from the published
+    artifact, smoke-check it like a reload candidate, `add_replica` it
+    into routing (the supervisor `_rebuild` recipe, new trigger);
+  * **scale-down** = `remove_replica`: mark the tail replica unroutable,
+    drain its in-flight requests (bounded), pop it, close it on a reaper
+    thread — never below one routable replica;
+  * **retune** = publish load-dependent ``microbatch_max_wait_ms`` /
+    ``max_rows`` under the batcher pause gate (BENCH_SERVE_r03 showed the
+    optimal knobs are load-dependent: wide coalescing buys throughput
+    under load and costs latency when idle);
+
+  with cooldown + hysteresis (fast up, slow down, ``stable_ticks``
+  consecutive idle evaluations before any retire) so it never flaps.
+
+- `BrownoutLadder` — the declared, ordered degradation sequence between
+  "healthy" and "shed", for load that arrives faster than capacity can
+  (or the ceiling allows): drop canary shadow taps → serve
+  ``degraded: true`` without SHAP → widen micro-batch coalescing → shed
+  bulk before single-row → shed everything. The controller engages one
+  rung per tick while the SLO fast-burns with no scale-up available, and
+  releases one rung per tick as burn clears — strictly symmetric, always
+  metered (``cobalt_brownout_level``,
+  ``cobalt_autoscaler_{resizes,retunes,brownouts}_total``).
+
+Operators steer it at ``POST /admin/autoscaler`` (pause / resume / force a
+fleet size) and observe it in the ``/readyz`` ``autoscaler`` block.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import TYPE_CHECKING, Callable
+
+from cobalt_smart_lender_ai_tpu.reliability.errors import (
+    RequestShed,
+    ValidationError,
+)
+from cobalt_smart_lender_ai_tpu.telemetry import default_tracer, get_logger
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard (replicas -> here)
+    from cobalt_smart_lender_ai_tpu.serve.replicas import ReplicaSet
+
+__all__ = [
+    "BROWNOUT_RUNGS",
+    "LEVEL_HEALTHY",
+    "LEVEL_NO_CANARY",
+    "LEVEL_NO_SHAP",
+    "LEVEL_WIDE_BATCH",
+    "LEVEL_SHED_BULK",
+    "LEVEL_SHED_ALL",
+    "BrownoutLadder",
+    "FleetAutoscaler",
+    "brownout_gate",
+]
+
+_LOG = get_logger("serve.autoscaler")
+
+#: The declared rung order. Each level includes every rung above it: at
+#: ``LEVEL_SHED_BULK`` the fleet is also skipping canary taps and SHAP.
+LEVEL_HEALTHY = 0  # full service
+LEVEL_NO_CANARY = 1  # drop canary shadow taps (invisible to clients)
+LEVEL_NO_SHAP = 2  # serve ``degraded: true`` without SHAP values
+LEVEL_WIDE_BATCH = 3  # widen micro-batch coalescing (latency for throughput)
+LEVEL_SHED_BULK = 4  # 429 bulk/CSV requests; single-row still serves
+LEVEL_SHED_ALL = 5  # 429 everything — the rung below this is "down"
+
+BROWNOUT_RUNGS = (
+    "healthy",
+    "no_canary",
+    "no_shap",
+    "wide_batch",
+    "shed_bulk",
+    "shed_all",
+)
+
+
+class BrownoutLadder:
+    """Pure, thread-safe brownout state: an integer level in
+    ``[0, max_level]`` walked one rung at a time. No threads, no I/O — the
+    autoscaler (or a test) drives it; the serving hot paths only *read*
+    `level`, so the check is one attribute load."""
+
+    def __init__(self, *, max_level: int = LEVEL_SHED_ALL):
+        self.max_level = max(0, min(int(max_level), LEVEL_SHED_ALL))
+        self.level = 0
+        self.engaged_total = 0
+        self.released_total = 0
+        self._lock = threading.Lock()
+
+    def engage(self, reason: str = "") -> tuple[int, int] | None:
+        """Step one rung down the ladder; returns ``(old, new)`` or None at
+        the configured ceiling."""
+        with self._lock:
+            if self.level >= self.max_level:
+                return None
+            old, self.level = self.level, self.level + 1
+            self.engaged_total += 1
+        _LOG.warning(
+            "brownout_engage",
+            level=self.level,
+            rung=BROWNOUT_RUNGS[self.level],
+            reason=reason,
+        )
+        return old, self.level
+
+    def release(self, reason: str = "") -> tuple[int, int] | None:
+        """Step one rung back up; returns ``(old, new)`` or None at 0."""
+        with self._lock:
+            if self.level <= 0:
+                return None
+            old, self.level = self.level, self.level - 1
+            self.released_total += 1
+        _LOG.info(
+            "brownout_release",
+            level=self.level,
+            rung=BROWNOUT_RUNGS[self.level],
+            reason=reason,
+        )
+        return old, self.level
+
+    @property
+    def rung(self) -> str:
+        return BROWNOUT_RUNGS[self.level]
+
+    def snapshot(self) -> dict:
+        return {
+            "level": self.level,
+            "rung": self.rung,
+            "max_level": self.max_level,
+            "engaged_total": self.engaged_total,
+            "released_total": self.released_total,
+        }
+
+
+def brownout_gate(
+    ladder: BrownoutLadder | None, kind: str, *, retry_after_s: float = 1.0
+) -> None:
+    """Shed-rung enforcement for the scoring entry points: raises the same
+    typed `RequestShed` (HTTP 429 + ``Retry-After``) admission control uses,
+    so clients can't tell a brownout shed from a capacity shed — both mean
+    "back off". ``kind`` is ``bulk`` or ``single``; bulk sheds first."""
+    if ladder is None:
+        return
+    level = ladder.level
+    if level >= LEVEL_SHED_ALL or (
+        level >= LEVEL_SHED_BULK and kind == "bulk"
+    ):
+        raise RequestShed(
+            f"brownout level {level} ({BROWNOUT_RUNGS[level]}): shedding "
+            f"{kind} requests",
+            retry_after_s=retry_after_s,
+        )
+
+
+class FleetAutoscaler:
+    """The resize/retune/brownout policy loop over a `ReplicaSet`.
+
+    Construction registers the ``cobalt_autoscaler_*`` families on the
+    fleet registry and wires nothing else — the thread starts via `start()`
+    (the adapters call `ReplicaSet.start_autoscaler` when their socket
+    opens), and `tick()` runs one full evaluation synchronously for tests
+    and for the loop."""
+
+    def __init__(
+        self,
+        fleet: "ReplicaSet",
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.fleet = fleet
+        self.config = fleet.config
+        self.brownout = fleet.brownout
+        self._clock = clock
+        self._sleep = sleep
+        self._stop_evt = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._tick_lock = threading.Lock()  # tick() and admin force serialize
+        self.paused = False
+        self._last_scale_up_at: float | None = None
+        self._last_scale_down_at: float | None = None
+        self._idle_ticks = 0
+        self._retuned_busy = False
+        self._last_signals: dict = {}
+        reg = fleet.registry
+        self._m_resizes = reg.counter(
+            "cobalt_autoscaler_resizes_total",
+            "fleet resizes the autoscaler performed, by direction",
+            ("direction",),
+        )
+        self._m_retunes = reg.counter(
+            "cobalt_autoscaler_retunes_total",
+            "micro-batch knob retunes published under the pause gate, by "
+            "profile (busy: wide coalescing; idle: configured defaults)",
+            ("profile",),
+        )
+        self._m_brownouts = reg.counter(
+            "cobalt_autoscaler_brownouts_total",
+            "brownout ladder steps, by direction (engage: one rung further "
+            "degraded; release: one rung recovered)",
+            ("direction",),
+        )
+        self._m_ticks = reg.counter(
+            "cobalt_autoscaler_ticks_total",
+            "autoscaler control-loop evaluations",
+        )
+
+    # -- lifecycle -------------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> None:
+        """Start the control loop (idempotent)."""
+        if self.running:
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="fleet-autoscaler"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        interval = max(0.05, float(self.config.autoscaler_interval_s))
+        while not self._stop_evt.wait(interval):
+            try:
+                self.tick()
+            except Exception as exc:  # the loop must outlive its own bugs
+                _LOG.error(
+                    "autoscaler_tick_failed",
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+
+    # -- signals ---------------------------------------------------------------
+
+    def _queue_wait_p95_ms(self) -> float | None:
+        """Max over replicas of the most recent queue-wait p95 from the
+        fleet history (the ``cobalt_microbatch_coalesce_wait_seconds:p95``
+        derived series under ``replica=i`` labels). None until the sampler
+        has produced points — the caller treats unknown as "not busy"."""
+        history = self.fleet.history
+        if history is None:
+            return None
+        worst: float | None = None
+        prefix = "cobalt_microbatch_coalesce_wait_seconds:p95"
+        try:
+            names = [
+                n for n in history.series_names() if n.startswith(prefix)
+            ]
+            for name in names:
+                res = history.query(
+                    name,
+                    window_s=4.0 * max(1.0, self.config.history_interval_s),
+                )
+                points = res.get("points") or []
+                if not points:
+                    continue
+                v = float(points[-1][1]) * 1000.0
+                if worst is None or v > worst:
+                    worst = v
+        except Exception:
+            return worst
+        return worst
+
+    def _signals(self) -> dict:
+        fleet = self.fleet
+        fast_burn = False
+        if fleet.slo is not None:
+            try:
+                fast_burn = bool(
+                    fleet.slo.evaluate(force=True).get("fast_burn")
+                )
+            except Exception:
+                fast_burn = False
+        adm = fleet.admission
+        util = 0.0
+        if adm.max_in_flight:
+            util = adm.in_flight / float(adm.max_in_flight)
+        with fleet._route_lock:
+            queue_depth = sum(
+                0 if rep.batcher is None else rep.batcher.queue_depth()
+                for rep in fleet.replicas
+            )
+            in_flight = sum(fleet._inflight)
+            n = len(fleet.replicas)
+        return {
+            "fast_burn": fast_burn,
+            "queue_wait_p95_ms": self._queue_wait_p95_ms(),
+            "util": round(util, 4),
+            "queue_depth": queue_depth,
+            "in_flight": in_flight,
+            "replicas": n,
+        }
+
+    # -- one control pass ------------------------------------------------------
+
+    def tick(self) -> dict:
+        """One policy evaluation: classify load, then (in priority order)
+        scale up, brown out, recover, retire. Exactly one resize or one
+        ladder step per tick — single-step actuation is the anti-flap
+        property the cooldowns build on."""
+        with self._tick_lock:
+            return self._tick_locked()
+
+    def _tick_locked(self) -> dict:
+        self._m_ticks.inc()
+        cfg = self.config
+        if self.paused:
+            return {"status": "paused"}
+        sig = self._signals()
+        self._last_signals = sig
+        now = self._clock()
+        qw = sig["queue_wait_p95_ms"]
+        busy = (
+            sig["fast_burn"]
+            or (qw is not None and qw >= cfg.autoscaler_queue_wait_high_ms)
+            or sig["util"] >= cfg.autoscaler_util_high
+        )
+        idle = (
+            not sig["fast_burn"]
+            and (qw is None or qw <= cfg.autoscaler_queue_wait_low_ms)
+            and sig["util"] <= cfg.autoscaler_util_low
+            and sig["queue_depth"] == 0
+        )
+        summary = {"signals": sig, "actions": []}
+        n = sig["replicas"]
+
+        if busy:
+            self._idle_ticks = 0
+            up_ok = (
+                n < cfg.autoscaler_max_replicas
+                and self._cooled(
+                    self._last_scale_up_at, cfg.autoscaler_scale_up_cooldown_s
+                )
+            )
+            if up_ok and self._scale_up():
+                summary["actions"].append("scale_up")
+            elif sig["fast_burn"] and cfg.brownout_enabled:
+                # Capacity can't come (ceiling or cooldown) and the SLO is
+                # burning: degrade one rung instead of collapsing.
+                step = self.brownout.engage(
+                    f"fast_burn at {n} replicas (max "
+                    f"{cfg.autoscaler_max_replicas})"
+                )
+                if step is not None:
+                    self._m_brownouts.labels(direction="engage").inc()
+                    summary["actions"].append(
+                        f"brownout:{BROWNOUT_RUNGS[step[1]]}"
+                    )
+        else:
+            # Burn has cleared: climb back up the ladder one rung per tick
+            # (strictly symmetric with engagement) before any capacity is
+            # retired — full service first, savings second.
+            if self.brownout.level > 0:
+                step = self.brownout.release("load cleared")
+                if step is not None:
+                    self._m_brownouts.labels(direction="release").inc()
+                    summary["actions"].append(
+                        f"brownout_release:{BROWNOUT_RUNGS[step[1]]}"
+                    )
+            elif idle:
+                self._idle_ticks += 1
+                down_ok = (
+                    n > max(1, cfg.autoscaler_min_replicas)
+                    and self._idle_ticks >= cfg.autoscaler_stable_ticks
+                    and self._cooled(
+                        self._last_scale_down_at,
+                        cfg.autoscaler_scale_down_cooldown_s,
+                    )
+                    and self._cooled(
+                        self._last_scale_up_at,
+                        cfg.autoscaler_scale_down_cooldown_s,
+                    )
+                )
+                if down_ok and self._scale_down():
+                    summary["actions"].append("scale_down")
+            else:
+                self._idle_ticks = 0
+
+        self._retune(
+            busy=busy or self.brownout.level >= LEVEL_WIDE_BATCH,
+            summary=summary,
+        )
+        return summary
+
+    def _cooled(self, stamp: float | None, cooldown_s: float) -> bool:
+        return stamp is None or (self._clock() - stamp) >= cooldown_s
+
+    # -- actuation -------------------------------------------------------------
+
+    def _scale_up(self) -> bool:
+        """Admit one new replica: the supervisor `_rebuild` recipe — fresh
+        `ScorerService` from the published artifact, smoke-checked — then
+        `ReplicaSet.add_replica` publishes it into routing and rescales
+        admission. A failed build is logged and retried next tick."""
+        from cobalt_smart_lender_ai_tpu.serve.replicas import (
+            resolve_replica_devices,
+        )
+        from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+        fleet = self.fleet
+        n = len(fleet.replicas)
+        try:
+            with default_tracer().span("autoscaler.scale_up", replicas=n + 1):
+                device = resolve_replica_devices(
+                    n + 1, self.config.replica_devices
+                )[n]
+                replica = ScorerService(
+                    fleet.artifact,
+                    self.config,
+                    store=fleet._store,
+                    clock=fleet._clock,
+                    device=device,
+                )
+                replica._model_key = fleet._model_key
+                replica._smoke_check(replica._model)
+        except Exception as exc:
+            _LOG.error(
+                "autoscaler_scale_up_failed",
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            return False
+        i = fleet.add_replica(replica)
+        self._last_scale_up_at = self._clock()
+        self._m_resizes.labels(direction="up").inc()
+        _LOG.info(
+            "autoscaler_scale_up", replica=i, replicas=len(fleet.replicas)
+        )
+        return True
+
+    def _scale_down(self) -> bool:
+        """Retire the tail replica through `ReplicaSet.remove_replica`
+        (drain + close + admission rescale). A refusal — the tail is mid-
+        heal, or the fleet is at the one-routable floor — is not an error;
+        the loop just tries again later."""
+        fleet = self.fleet
+        try:
+            with default_tracer().span(
+                "autoscaler.scale_down", replicas=len(fleet.replicas) - 1
+            ):
+                result = fleet.remove_replica()
+        except ValidationError as exc:
+            _LOG.info("autoscaler_scale_down_refused", reason=str(exc))
+            return False
+        self._last_scale_down_at = self._clock()
+        self._idle_ticks = 0
+        self._m_resizes.labels(direction="down").inc()
+        _LOG.info(
+            "autoscaler_scale_down",
+            replica=result["replica"],
+            replicas=result["replicas"],
+        )
+        return True
+
+    def _retune(self, *, busy: bool, summary: dict) -> None:
+        """Publish load-dependent micro-batch knobs under the pause gate.
+        Busy: wide coalescing (``autoscaler_busy_wait_ms`` /
+        ``busy_max_rows``). Idle: the configured defaults. Idempotent — the
+        counter only moves when a knob actually changes."""
+        cfg = self.config
+        if not cfg.autoscaler_retune_enabled or busy == self._retuned_busy:
+            return
+        if busy:
+            wait_s = cfg.autoscaler_busy_wait_ms / 1000.0
+            rows = min(cfg.autoscaler_busy_max_rows, cfg.max_batch_rows)
+            profile = "busy"
+        else:
+            wait_s = cfg.microbatch_max_wait_ms / 1000.0
+            rows = min(cfg.microbatch_max_rows, cfg.max_batch_rows)
+            profile = "idle"
+        retuned = 0
+        with self.fleet._route_lock:
+            replicas = list(self.fleet.replicas)
+        for rep in replicas:
+            batcher = rep.batcher
+            if batcher is None or batcher.closed:
+                continue
+            with batcher.pause():
+                batcher._max_wait_s = wait_s
+                batcher._max_rows = rows
+            retuned += 1
+        self._retuned_busy = busy
+        if retuned:
+            self._m_retunes.labels(profile=profile).inc()
+            summary["actions"].append(f"retune:{profile}")
+            _LOG.info(
+                "autoscaler_retune",
+                profile=profile,
+                max_wait_ms=wait_s * 1000.0,
+                max_rows=rows,
+                replicas=retuned,
+            )
+
+    # -- admin / observability -------------------------------------------------
+
+    def pause(self) -> dict:
+        self.paused = True
+        return {"status": "paused"}
+
+    def resume(self) -> dict:
+        self.paused = False
+        return {"status": "resumed"}
+
+    def force(self, replicas: int) -> dict:
+        """Operator-forced fleet size: walk to ``replicas`` one step at a
+        time through the same add/remove paths (bounds still apply, the
+        one-routable floor still holds), bypassing cooldowns and signals."""
+        try:
+            target = int(replicas)
+        except (TypeError, ValueError):
+            raise ValidationError(
+                f"replicas must be an integer, got {replicas!r}"
+            )
+        cfg = self.config
+        lo = max(1, cfg.autoscaler_min_replicas)
+        hi = max(lo, cfg.autoscaler_max_replicas)
+        if not lo <= target <= hi:
+            raise ValidationError(
+                f"replicas must be in [{lo}, {hi}], got {target}"
+            )
+        with self._tick_lock:
+            steps = []
+            guard = 0
+            while len(self.fleet.replicas) != target and guard < 32:
+                guard += 1
+                if len(self.fleet.replicas) < target:
+                    if not self._scale_up():
+                        break
+                    steps.append("up")
+                else:
+                    if not self._scale_down():
+                        break
+                    steps.append("down")
+            return {
+                "status": "ok",
+                "replicas": len(self.fleet.replicas),
+                "target": target,
+                "steps": steps,
+            }
+
+    def status(self) -> dict:
+        """The ``/readyz`` ``autoscaler`` block — the runbook's first stop
+        for "did the autoscaler act, and at which rung?"."""
+        return {
+            "enabled": True,
+            "running": self.running,
+            "paused": self.paused,
+            "replicas": len(self.fleet.replicas),
+            "min_replicas": max(1, self.config.autoscaler_min_replicas),
+            "max_replicas": self.config.autoscaler_max_replicas,
+            "idle_ticks": self._idle_ticks,
+            "brownout": self.brownout.snapshot(),
+            "last_signals": self._last_signals,
+        }

@@ -203,6 +203,11 @@ class AsyncScorerServer:
         start_supervisor = getattr(self.service, "start_supervisor", None)
         if start_supervisor is not None:
             start_supervisor()
+        # And for load adaptation: the autoscaler control loop reacts to
+        # request telemetry, which only exists once requests can arrive.
+        start_autoscaler = getattr(self.service, "start_autoscaler", None)
+        if start_autoscaler is not None:
+            start_autoscaler()
         return self
 
     def start(self) -> "AsyncScorerServer":
@@ -534,6 +539,22 @@ class AsyncScorerServer:
                         "/admin/readmit requires replicas >= 2"
                     )
                 result = await _in_executor(fn, replica)
+            await self._send(st, 200, result)
+            return
+        if st.route_path == "/admin/autoscaler":
+            # Autoscaler control plane: pause/resume the control loop,
+            # force a replica count, or read status. Fleet-only, like the
+            # quarantine/readmit pair above.
+            payload = self._json_body(body)
+            if not isinstance(payload, dict):
+                raise ValidationError("body must be a JSON object")
+            fn = getattr(service, "autoscaler_admin", None)
+            if fn is None:
+                raise ValidationError(
+                    "service is not a replicated fleet; "
+                    "/admin/autoscaler requires replicas >= 2"
+                )
+            result = await _in_executor(fn, payload)
             await self._send(st, 200, result)
             return
         if st.route_path == "/predict":

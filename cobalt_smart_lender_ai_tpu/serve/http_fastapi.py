@@ -131,6 +131,10 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
     class ReadmitInput(BaseModel):
         replica: int
 
+    class AutoscalerInput(BaseModel):
+        action: str = "status"
+        replicas: Optional[int] = None
+
     state: dict[str, ScorerService] = {}
     if service is not None:
         state["service"] = service
@@ -152,6 +156,11 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
         start_supervisor = getattr(state["service"], "start_supervisor", None)
         if start_supervisor is not None:
             start_supervisor()
+        # And load adaptation: the autoscaler reacts to request telemetry,
+        # which only exists once the app can take traffic.
+        start_autoscaler = getattr(state["service"], "start_autoscaler", None)
+        if start_autoscaler is not None:
+            start_autoscaler()
         yield
         if owns_service:
             # shutdown: drain the micro-batch scheduler (a service passed in
@@ -388,6 +397,32 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
                 raise exc
             try:
                 return await _in_executor(fn, data.replica)
+            except RequestError as e:
+                _raise_typed(e)
+
+    @app.post("/admin/autoscaler")
+    async def admin_autoscaler(
+        data: AutoscalerInput, request: Request = None, response: Response = None
+    ):
+        # Autoscaler control plane: pause/resume the control loop, force a
+        # replica count, or read status — ungated like the rest of the
+        # admin plane.
+        with _track("/admin/autoscaler", request, response):
+            from cobalt_smart_lender_ai_tpu.serve.service import _in_executor
+
+            fn = getattr(state["service"], "autoscaler_admin", None)
+            if fn is None:
+                exc = HTTPException(
+                    status_code=422,
+                    detail="service is not a replicated fleet; "
+                    "/admin/autoscaler requires replicas >= 2",
+                )
+                exc.cobalt_code = "invalid_input"
+                raise exc
+            try:
+                return await _in_executor(
+                    fn, data.model_dump(exclude_none=True)
+                )
             except RequestError as e:
                 _raise_typed(e)
 

@@ -49,6 +49,7 @@ _KNOWN_ROUTES = frozenset(
         "/admin/rollback",
         "/admin/quarantine",
         "/admin/readmit",
+        "/admin/autoscaler",
         "/healthz",
         "/readyz",
         "/metrics",

@@ -53,6 +53,12 @@ from cobalt_smart_lender_ai_tpu.reliability.admission import (
     admission_from_config,
 )
 from cobalt_smart_lender_ai_tpu.reliability.errors import ValidationError
+from cobalt_smart_lender_ai_tpu.serve.autoscaler import (
+    BrownoutLadder,
+    FleetAutoscaler,
+    LEVEL_NO_CANARY,
+    brownout_gate,
+)
 from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
 from cobalt_smart_lender_ai_tpu.serve.supervisor import (
     HEALTHY,
@@ -117,6 +123,20 @@ class ReplicaSet:
         self._route_lock = threading.Lock()
         self._inflight = [0] * len(replicas)
         self._rr = 0  # round-robin tie-break cursor
+        # Runtime resizes (autoscaler or operator) serialize here so two
+        # concurrent removals can't both drain the same tail slot.
+        self._resize_lock = threading.Lock()
+        # Brownout ladder (serve.autoscaler): always present so the scoring
+        # hot paths can read one attribute; it only moves off level 0 when
+        # the autoscaler (or a test/operator) drives it. Every replica
+        # shares the FLEET's ladder — degradation is a fleet-wide policy.
+        self.brownout = BrownoutLadder(
+            max_level=config.brownout_max_level
+            if config.brownout_enabled
+            else 0
+        )
+        for rep in replicas:
+            rep.brownout = self.brownout
         # Per-replica health state machines (serve.supervisor): always
         # present — the router reads ``routable`` and ``error_ewma`` on
         # every pick — while the healing loop below is config-gated.
@@ -139,6 +159,11 @@ class ReplicaSet:
         # read, fed by the same contextvar phase accumulators the replicas
         # already write to.
         self.admission = admission_from_config(config.reliability, clock=clock)
+        # The reliability knobs describe ONE replica's capacity; the fleet
+        # door multiplies them by the fleet size, and every runtime resize
+        # recomputes them (`add_replica` / `remove_replica`) so shedding
+        # thresholds track actual capacity.
+        self.admission.rescale(len(replicas))
         self.registry = MetricsRegistry()
         self.flight = FlightRecorder(
             capacity=config.flight_capacity,
@@ -210,6 +235,13 @@ class ReplicaSet:
                 interval_s=config.history_interval_s,
                 tiers=config.history_tiers,
             )
+        # The load-adaptive policy loop (serve.autoscaler): constructed
+        # last so it can read the SLO engine, history, and admission
+        # controller above; the thread itself starts with the HTTP server
+        # (`start_autoscaler`), like the supervisor and history sampler.
+        self.autoscaler: FleetAutoscaler | None = None
+        if config.autoscaler_enabled:
+            self.autoscaler = FleetAutoscaler(self, clock=clock)
 
     def start_history(self) -> None:
         """Start the fleet history sampler (idempotent) — the adapters
@@ -225,6 +257,13 @@ class ReplicaSet:
         drive `FleetSupervisor.tick` directly instead."""
         if self.supervisor is not None:
             self.supervisor.start()
+
+    def start_autoscaler(self) -> None:
+        """Start the autoscaler control loop (idempotent) — called by the
+        adapters when their socket opens, mirroring `start_supervisor`.
+        Fake-clock tests drive `FleetAutoscaler.tick` directly instead."""
+        if self.autoscaler is not None:
+            self.autoscaler.start()
 
     @classmethod
     def from_store(
@@ -305,16 +344,23 @@ class ReplicaSet:
         shed.labels(gate="capacity").set_function(lambda: adm.shed_capacity)
         # The per-replica break-out the ISSUE names: load, routing volume,
         # and queue depth per replica — the router's own inputs, exported.
+        # `cobalt_replica_count` is a collect-time read so runtime resizes
+        # (serve.autoscaler) show up without anyone remembering to set it.
         reg.gauge(
             "cobalt_replica_count", "serving replicas behind the router"
-        ).set(len(self.replicas))
-        g_inflight = reg.gauge(
+        ).set_function(lambda: len(self.replicas))
+        reg.gauge(
+            "cobalt_brownout_level",
+            "current brownout ladder rung (0 healthy .. 5 shed-everything; "
+            "see serve.autoscaler.BROWNOUT_RUNGS)",
+        ).set_function(lambda: float(self.brownout.level))
+        self._g_inflight = reg.gauge(
             "cobalt_replica_in_flight",
             "requests currently routed to (and not yet returned by) each "
             "replica",
             ("replica",),
         )
-        g_queue = reg.gauge(
+        self._g_queue = reg.gauge(
             "cobalt_replica_queue_depth",
             "each replica's micro-batch queue depth (0 when coalescing is "
             "off)",
@@ -328,25 +374,18 @@ class ReplicaSet:
         # Supervision families (serve.supervisor): state + EWMA are
         # collect-time reads of the health records; transitions, hedges and
         # quarantines are incremented at the event.
-        g_state = reg.gauge(
+        self._g_state = reg.gauge(
             "cobalt_supervisor_state",
             "replica health state (0 healthy, 1 degraded, 2 quarantined, "
-            "3 restarting)",
+            "3 restarting; a retired slot reports 3)",
             ("replica",),
         )
-        g_ewma = reg.gauge(
+        self._g_ewma = reg.gauge(
             "cobalt_supervisor_error_ewma",
             "per-replica error-rate EWMA over routed outcomes "
             "(replica-internal failures only)",
             ("replica",),
         )
-        for i in range(len(self.replicas)):
-            g_state.labels(replica=str(i)).set_function(
-                lambda i=i: float(STATE_CODES[self.replica_health[i].state])
-            )
-            g_ewma.labels(replica=str(i)).set_function(
-                lambda i=i: self.replica_health[i].error_ewma
-            )
         self._m_transitions = reg.counter(
             "cobalt_supervisor_transitions_total",
             "replica health-state transitions by replica and target state",
@@ -384,34 +423,30 @@ class ReplicaSet:
         # the per-replica program/dispatch families under a ``replica``
         # label, so one scrape attributes fleet time to compiled programs
         # without visiting N replica registries.
-        c_bulk_rows = reg.counter(
+        self._c_bulk_rows = reg.counter(
             "cobalt_bulk_rows_total",
             "rows scored through each replica's bulk (sharded) path",
             ("replica",),
         )
-        c_bulk_disp = reg.counter(
+        self._c_bulk_disp = reg.counter(
             "cobalt_bulk_dispatches_total",
             "device dispatches issued by each replica's bulk path",
             ("replica",),
         )
-        # Closures capture the INDEX, not the replica object: the supervisor
-        # swaps healed replicas in place (`_swap_replica`), and the gauges
-        # must follow the slot, not a dead object.
+        # Pinned fleet: each replica's compiled programs carry its device in
+        # their meta, so a device-filtered publication gives every replica
+        # exactly its own rows. Thread-backed replicas share the one device
+        # and hence the structure-keyed executables; a replica label would
+        # just replicate identical rows N times.
+        self._pinned_publish = any(
+            rep._device is not None for rep in self.replicas
+        )
+        # High-water mark of registered slots: a scale-down followed by a
+        # scale-up reuses the slot's existing label series instead of
+        # re-registering collect functions on it.
+        self._slots_registered = 0
         for i in range(len(self.replicas)):
-            g_inflight.labels(replica=str(i)).set_function(
-                lambda i=i: self._inflight[i]
-            )
-            g_queue.labels(replica=str(i)).set_function(
-                lambda i=i: 0
-                if self.replicas[i].batcher is None
-                else self.replicas[i].batcher.queue_depth()
-            )
-            c_bulk_rows.labels(replica=str(i)).set_function(
-                lambda i=i: self.replicas[i]._m_bulk_rows.value
-            )
-            c_bulk_disp.labels(replica=str(i)).set_function(
-                lambda i=i: self.replicas[i]._m_bulk_dispatches.value
-            )
+            self._register_replica_metrics(i)
         from cobalt_smart_lender_ai_tpu.telemetry.devices import (
             install_device_metrics,
         )
@@ -419,19 +454,64 @@ class ReplicaSet:
             default_program_registry,
         )
 
-        preg = default_program_registry()
-        if any(rep._device is not None for rep in self.replicas):
-            # Pinned fleet: each replica's compiled programs carry its
-            # device in their meta, so a device-filtered publication gives
-            # every replica exactly its own rows.
-            for i, rep in enumerate(self.replicas):
-                preg.publish(reg, replica=str(i), device=str(rep._device))
-        else:
-            # Thread-backed replicas share the one device and hence the
-            # structure-keyed executables; a replica label would just
-            # replicate identical rows N times.
-            preg.publish(reg)
+        if not self._pinned_publish:
+            default_program_registry().publish(reg)
         install_device_metrics(reg)
+
+    def _register_replica_metrics(self, i: int) -> None:
+        """Register the per-slot collect functions for routing slot ``i``
+        (idempotent past the high-water mark, so runtime `add_replica` into
+        a previously-retired slot keeps its stable label series).
+
+        Closures capture the slot INDEX, not the replica object: the
+        supervisor swaps healed replicas in place (`_swap_replica`) and the
+        autoscaler grows/shrinks the list, so every read is defensive — a
+        retired slot reports zero load and a RESTARTING state instead of
+        raising IndexError mid-scrape."""
+        if i < self._slots_registered:
+            return
+        self._slots_registered = i + 1
+
+        def _rep(i: int) -> ScorerService | None:
+            return self.replicas[i] if i < len(self.replicas) else None
+
+        self._g_state.labels(replica=str(i)).set_function(
+            lambda i=i: float(
+                STATE_CODES[self.replica_health[i].state]
+                if i < len(self.replica_health)
+                else STATE_CODES[RESTARTING]
+            )
+        )
+        self._g_ewma.labels(replica=str(i)).set_function(
+            lambda i=i: self.replica_health[i].error_ewma
+            if i < len(self.replica_health)
+            else 0.0
+        )
+        self._g_inflight.labels(replica=str(i)).set_function(
+            lambda i=i: self._inflight[i] if i < len(self._inflight) else 0
+        )
+        self._g_queue.labels(replica=str(i)).set_function(
+            lambda i=i: 0
+            if _rep(i) is None or _rep(i).batcher is None
+            else _rep(i).batcher.queue_depth()
+        )
+        self._c_bulk_rows.labels(replica=str(i)).set_function(
+            lambda i=i: 0 if _rep(i) is None else _rep(i)._m_bulk_rows.value
+        )
+        self._c_bulk_disp.labels(replica=str(i)).set_function(
+            lambda i=i: 0
+            if _rep(i) is None
+            else _rep(i)._m_bulk_dispatches.value
+        )
+        if self._pinned_publish:
+            from cobalt_smart_lender_ai_tpu.telemetry.programs import (
+                default_program_registry,
+            )
+
+            rep = self.replicas[i]
+            default_program_registry().publish(
+                self.registry, replica=str(i), device=str(rep._device)
+            )
 
     # -- routing ---------------------------------------------------------------
 
@@ -497,11 +577,17 @@ class ReplicaSet:
             ok = not replica_internal(exc)
             raise
         finally:
+            # Defensive against a concurrent tail retire: a straggler that
+            # outlived its slot's drain window has nothing to decrement —
+            # the slot (and its health record) are gone.
             with self._route_lock:
-                self._inflight[i] -= 1
+                if i < len(self._inflight):
+                    self._inflight[i] -= 1
             self._record_outcome(i, ok)
 
     def _record_outcome(self, i: int, ok: bool) -> None:
+        if i >= len(self.replica_health):
+            return  # the slot was retired while this request was in flight
         h = self.replica_health[i]
         # Auto-quarantine only when a supervisor exists to heal it;
         # otherwise the machine tops out at degraded and the router
@@ -539,9 +625,105 @@ class ReplicaSet:
         heal path). Under the route lock so no pick sees a half-swapped
         slot; the per-slot metric closures read ``self.replicas[i]`` and
         follow automatically."""
+        replacement.brownout = self.brownout
         with self._route_lock:
             old, self.replicas[i] = self.replicas[i], replacement
         return old
+
+    def add_replica(self, replica: ScorerService) -> int:
+        """Publish a new replica into the routing table at runtime (the
+        autoscaler's scale-up path; callers build + smoke-check it first).
+        Appends — never reuses a mid-list slot — so existing indices, and
+        with them every metric label and health record, stay stable while
+        traffic is in flight. Admission capacity is recomputed for the new
+        fleet size."""
+        replica.brownout = self.brownout
+        cfg = self.config
+        with self._route_lock:
+            i = len(self.replicas)
+            self.replicas.append(replica)
+            self._inflight.append(0)
+            self.replica_health.append(
+                ReplicaHealth(
+                    i,
+                    alpha=cfg.supervisor_ewma_alpha,
+                    degraded_ewma=cfg.supervisor_degraded_ewma,
+                    quarantine_ewma=cfg.supervisor_quarantine_ewma,
+                    recover_ewma=cfg.supervisor_recover_ewma,
+                    clock=self._clock,
+                )
+            )
+        self._register_replica_metrics(i)
+        admission = self.admission.rescale(len(self.replicas))
+        _LOG.info("replica_added", replica=i, admission=admission)
+        return i
+
+    def remove_replica(self, *, drain_timeout_s: float | None = None) -> dict:
+        """Drain + retire the tail replica at runtime (the autoscaler's
+        scale-down path). Only the TAIL is ever removed — popping a
+        mid-list slot would renumber every replica above it under live
+        traffic — and never below one routable replica. The victim is
+        marked RESTARTING first (the router stops picking it), its routed
+        in-flight requests get a bounded drain, then it is popped and
+        closed on a reaper thread; stragglers finish against the old
+        object, which stays alive until its close completes."""
+        with self._resize_lock:
+            with self._route_lock:
+                n = len(self.replicas)
+                i = n - 1
+                routable = sum(h.routable for h in self.replica_health)
+            if n <= 1 or (self.replica_health[i].routable and routable <= 1):
+                raise ValidationError(
+                    "refusing to retire below one routable replica "
+                    "(the fleet would go dark)"
+                )
+            h = self.replica_health[i]
+            if not h.routable:
+                raise ValidationError(
+                    f"tail replica {i} is {h.state} (being healed); "
+                    "retry the retire once it settles"
+                )
+            self._note_transition(
+                i, *h.to(RESTARTING, "retiring (scale-down)")
+            )
+            timeout = (
+                float(drain_timeout_s)
+                if drain_timeout_s is not None
+                else float(self.config.supervisor_drain_timeout_s)
+            )
+            give_up = self._clock() + timeout
+            drained, spins = False, 0
+            while spins < 10_000:
+                spins += 1
+                with self._route_lock:
+                    if self._inflight[i] == 0:
+                        drained = True
+                        break
+                if self._clock() >= give_up:
+                    break
+                time.sleep(0.02)
+            with self._route_lock:
+                old = self.replicas.pop()
+                self._inflight.pop()
+                self.replica_health.pop()
+                self._rr %= max(1, len(self.replicas))
+            threading.Thread(
+                target=old.close, daemon=True, name=f"replica-retire-{i}"
+            ).start()
+            admission = self.admission.rescale(len(self.replicas))
+            _LOG.info(
+                "replica_retired",
+                replica=i,
+                replicas=len(self.replicas),
+                drained=drained,
+                admission=admission,
+            )
+            return {
+                "status": "retired",
+                "replica": i,
+                "replicas": len(self.replicas),
+                "drained": drained,
+            }
 
     # -- the adapter-facing surface --------------------------------------------
 
@@ -563,9 +745,15 @@ class ReplicaSet:
             return None
         return (failed,)
 
+    def _shed_hint_s(self) -> float:
+        return float(self.config.reliability.shed_retry_after_s)
+
     def predict_single(
         self, payload: Mapping[str, Any], *, deadline=None
     ) -> dict:
+        brownout_gate(
+            self.brownout, "single", retry_after_s=self._shed_hint_s()
+        )
         first: int | None = None
         try:
             with self._routed() as (i, rep):
@@ -589,10 +777,12 @@ class ReplicaSet:
             self._m_hedges.labels(outcome="rescued").inc()
         # The replicas serve anonymously (their `_model_identity` stays
         # None); the fleet's identity and shadow tap live on the facade.
+        # Brownout rung 1 drops the shadow tap — the cheapest shedding
+        # there is, invisible to the caller.
         if self._model_identity is not None:
             resp["model_version"] = self._model_identity["version"]
         can = self.canary
-        if can is not None:
+        if can is not None and self.brownout.level < LEVEL_NO_CANARY:
             can.tap(resp["input_row"], resp["prob_default"], None)
         return resp
 
@@ -606,6 +796,9 @@ class ReplicaSet:
         append; serve/canary.py). Hedged failover mirrors the sync path:
         one retry on a different replica, replica-internal failures only,
         inside the caller's deadline."""
+        brownout_gate(
+            self.brownout, "single", retry_after_s=self._shed_hint_s()
+        )
         first: int | None = None
         try:
             with self._routed() as (i, rep):
@@ -634,29 +827,33 @@ class ReplicaSet:
         if self._model_identity is not None:
             resp["model_version"] = self._model_identity["version"]
         can = self.canary
-        if can is not None:
+        if can is not None and self.brownout.level < LEVEL_NO_CANARY:
             can.tap(resp["input_row"], resp["prob_default"], None)
         return resp
 
     def predict_bulk_csv(self, csv_bytes: bytes, *, deadline=None) -> dict:
+        brownout_gate(self.brownout, "bulk", retry_after_s=self._shed_hint_s())
         with self._routed() as (_i, rep):
             return rep.predict_bulk_csv(csv_bytes, deadline=deadline)
 
     async def predict_bulk_csv_async(
         self, csv_bytes: bytes, *, deadline=None
     ) -> dict:
+        brownout_gate(self.brownout, "bulk", retry_after_s=self._shed_hint_s())
         with self._routed() as (_i, rep):
             return await rep.predict_bulk_csv_async(csv_bytes, deadline=deadline)
 
     def feature_importance_bulk(
         self, payload: Mapping[str, Any], *, deadline=None
     ) -> dict:
+        brownout_gate(self.brownout, "bulk", retry_after_s=self._shed_hint_s())
         with self._routed() as (_i, rep):
             return rep.feature_importance_bulk(payload, deadline=deadline)
 
     async def feature_importance_bulk_async(
         self, payload: Mapping[str, Any], *, deadline=None
     ) -> dict:
+        brownout_gate(self.brownout, "bulk", retry_after_s=self._shed_hint_s())
         with self._routed() as (_i, rep):
             return await rep.feature_importance_bulk_async(
                 payload, deadline=deadline
@@ -751,6 +948,12 @@ class ReplicaSet:
             ),
             "bulk": per[0][1].get("bulk"),
             "admission": self.admission.stats(),
+            "brownout": self.brownout.snapshot(),
+            "autoscaler": (
+                self.autoscaler.status()
+                if self.autoscaler is not None
+                else {"enabled": False}
+            ),
             "per_replica": [p for _, p in per],
         }
         if self._last_reload is not None:
@@ -964,12 +1167,38 @@ class ReplicaSet:
         self._note_transition(i, *h.to(HEALTHY, "manual readmit"))
         return {"status": "readmitted", "replica": i, "supervisor": h.snapshot()}
 
+    def autoscaler_admin(self, payload: Mapping[str, Any] | None) -> dict:
+        """``POST /admin/autoscaler`` — the operator's steering wheel:
+        ``{"action": "pause"|"resume"|"status"}`` or
+        ``{"action": "force", "replicas": n}`` (walks the fleet to ``n``
+        through the same add/remove paths, bypassing cooldowns)."""
+        if self.autoscaler is None:
+            raise ValidationError(
+                "autoscaler is not enabled on this fleet "
+                "(ServeConfig.autoscaler_enabled)"
+            )
+        action = (payload or {}).get("action", "status")
+        if action == "pause":
+            return self.autoscaler.pause()
+        if action == "resume":
+            return self.autoscaler.resume()
+        if action == "status":
+            return self.autoscaler.status()
+        if action == "force":
+            return self.autoscaler.force((payload or {}).get("replicas"))
+        raise ValidationError(
+            f"unknown autoscaler action {action!r}; expected pause, resume, "
+            "status, or force"
+        )
+
     def close(self) -> None:
         """Shut the fleet down with replicas draining CONCURRENTLY under a
         bounded timeout: closing serially would stack worker-join waits, so
         one wedged replica (a chaos-hung worker, a stuck dispatch) could
         hold shutdown for the whole fleet. Stragglers are left to their
         daemon threads and logged, not waited for."""
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if self.supervisor is not None:
             self.supervisor.stop()
         if self.canary is not None:

@@ -108,6 +108,13 @@ _LOG = get_logger("cobalt.serve")
 #: sizes are already padded to powers of two, so these bounds are exact.
 _BATCH_ROW_BUCKETS = tuple(float(1 << i) for i in range(11))  # 1 .. 1024
 
+#: SHAP-degrade reason used when the brownout ladder (serve.autoscaler)
+#: sheds the SHAP phase under load. Unlike a compile failure this is
+#: transient by construction, so it must NEVER be persisted into
+#: `model.shap_error` — readiness reports recover the moment the ladder
+#: steps back below rung 2.
+BROWNOUT_SHAP_SHED = "brownout: SHAP shed under load"
+
 __all__ = [
     "SINGLE_INPUT_FIELDS",
     "MicroBatcher",
@@ -909,11 +916,22 @@ class MicroBatcher:
                 )[:n]
             phis = base = None
             shap_error: str | None = None
+            bo = self._service.brownout
             with default_tracer().span(
                 "serve.shap", rows=n, bucket=bucket
             ) as s_sp:
                 shap_fn = model.shap_for_bucket(bucket)
-                if shap_fn is None:
+                if (
+                    bo is not None
+                    and bo.level >= 2
+                    and self._service.config.reliability.degrade_shap
+                ):
+                    # Brownout rung 2: shed the SHAP phase (the dominant
+                    # per-batch cost) but keep scoring. The sentinel is
+                    # load-shedding, not a compile failure — `_finish_batched`
+                    # must never persist it into `model.shap_error`.
+                    shap_error = BROWNOUT_SHAP_SHED
+                elif shap_fn is None:
                     shap_error = (
                         model.shap_error or "SHAP program unavailable"
                     )
@@ -1073,6 +1091,10 @@ class ScorerService:
         # Continuous-training loop (serve.canary): populated by
         # `enable_canary`; None keeps the pre-registry behavior bit-for-bit.
         self.canary = None
+        # Brownout ladder (serve.autoscaler): `ReplicaSet` shares its
+        # fleet-wide ladder with every replica by assigning this attribute;
+        # a bare service keeps None and every brownout hook is a no-op.
+        self.brownout = None
         self._model_identity: dict | None = None
         self._model = _CompiledModel(artifact, self.config, device=device)
         self.batcher: MicroBatcher | None = None
@@ -1575,8 +1597,16 @@ class ScorerService:
         latency_s: float | None,
     ) -> None:
         can = self.canary
-        if can is not None:
-            can.tap(row, prob, latency_s)
+        if can is None:
+            return
+        # Brownout rung 1 (serve.autoscaler): under load the canary tap is
+        # the first thing to go — it is advisory bookkeeping, not part of
+        # the scoring contract. One check here covers every tap site
+        # (cache hit, batched, direct).
+        bo = self.brownout
+        if bo is not None and bo.level >= 1:
+            return
+        can.tap(row, prob, latency_s)
 
     # -- scoring helpers ------------------------------------------------------
 
@@ -1694,8 +1724,11 @@ class ScorerService:
                 if self.batcher is None
                 else {
                     "enabled": True,
-                    "max_wait_ms": self.config.microbatch_max_wait_ms,
-                    "max_rows": self.config.microbatch_max_rows,
+                    # Live batcher knobs, not the config values: the
+                    # autoscaler retunes these under load and /readyz is
+                    # where operators verify which profile is active.
+                    "max_wait_ms": self.batcher._max_wait_s * 1000.0,
+                    "max_rows": self.batcher._max_rows,
                     "prewarm_all_buckets": self.config.prewarm_all_buckets,
                     **self.batcher.stats(),
                 }
@@ -1792,7 +1825,10 @@ class ScorerService:
             err = shap_error or "SHAP program unavailable"
             if not self.config.reliability.degrade_shap:
                 raise RuntimeError(err)
-            if model.shap_error is None:
+            # A brownout shed is transient load management, not a broken
+            # program: persisting it would keep /readyz degraded after the
+            # ladder releases.
+            if model.shap_error is None and err != BROWNOUT_SHAP_SHED:
                 model.shap_error = err
             resp["shap_values"] = None
             resp["base_value"] = None
@@ -1981,28 +2017,45 @@ class ScorerService:
         # `"shap_values": null` plus a `degraded` flag; healthy responses keep
         # the reference's exact key set (no flag), which existing clients
         # assert on.
-        try:
-            if dl is not None:
-                dl.check("probability scored")
-            if model.shap_fn is None:
-                raise RuntimeError(model.shap_error or "SHAP program unavailable")
-            with self.phase("shap"):
-                phis, base = model.shap_fn(x)
-            resp["shap_values"] = np.asarray(phis)[0].tolist()
-            resp["base_value"] = float(base)
-        except DeadlineExceeded:
-            # Past the deadline the client is gone — a late degraded 200
-            # helps nobody; this is the 504 path, not the degrade path.
-            raise
-        except Exception as exc:
-            if not self.config.reliability.degrade_shap:
-                raise
-            if model.shap_error is None:
-                model.shap_error = f"{type(exc).__name__}: {exc}"
+        bo = self.brownout
+        if (
+            bo is not None
+            and bo.level >= 2
+            and self.config.reliability.degrade_shap
+        ):
+            # Brownout rung 2: shed the SHAP phase under load but keep the
+            # score. Transient by construction — never recorded into
+            # `model.shap_error`, so /readyz recovers the moment the ladder
+            # steps back down.
             resp["shap_values"] = None
             resp["base_value"] = None
             resp["degraded"] = True
             self._m_shap_degraded.inc()
+        else:
+            try:
+                if dl is not None:
+                    dl.check("probability scored")
+                if model.shap_fn is None:
+                    raise RuntimeError(
+                        model.shap_error or "SHAP program unavailable"
+                    )
+                with self.phase("shap"):
+                    phis, base = model.shap_fn(x)
+                resp["shap_values"] = np.asarray(phis)[0].tolist()
+                resp["base_value"] = float(base)
+            except DeadlineExceeded:
+                # Past the deadline the client is gone — a late degraded 200
+                # helps nobody; this is the 504 path, not the degrade path.
+                raise
+            except Exception as exc:
+                if not self.config.reliability.degrade_shap:
+                    raise
+                if model.shap_error is None:
+                    model.shap_error = f"{type(exc).__name__}: {exc}"
+                resp["shap_values"] = None
+                resp["base_value"] = None
+                resp["degraded"] = True
+                self._m_shap_degraded.inc()
         if cache_key is not None and resp.get("shap_values") is not None:
             self._score_cache_put(
                 cache_key,

@@ -292,6 +292,8 @@ class FleetSupervisor:
         cfg = self.config
         summary = {"probed": 0, "quarantined": 0, "healed": 0, "revived": 0}
         for i in range(len(fleet.replicas)):
+            if i >= len(fleet.replicas):
+                break  # the autoscaler retired the tail mid-tick
             h = fleet.replica_health[i]
             if h.state == RESTARTING:
                 continue

@@ -147,6 +147,22 @@ def _from_serve_chaos(record: dict, metrics: dict) -> None:
     _put(metrics, "serve.chaos.heal_s", sup.get("heal_s"))
 
 
+def _from_serve_traffic(record: dict, metrics: dict) -> None:
+    """BENCH_TRAFFIC / bench_serve --traffic: the load-adaptive fleet under
+    an open-loop arrival shape. The shape joins the series name — a
+    flash-crowd run and a diurnal run measure different workloads. ``qps``
+    and ``p999`` auto-gate by name shape; errors / untyped ride along
+    tracked-only (the hard ``== 0`` gates live in the autoscale-smoke CI
+    job, which reads the record directly)."""
+    shape = (record.get("traffic") or {}).get("shape") or "unknown"
+    load = record.get("load") or {}
+    base = f"serve.traffic.{shape}"
+    _put(metrics, f"{base}.qps", load.get("qps"))
+    _put(metrics, f"{base}.p999_ms", load.get("p99.9_ms"))
+    _put(metrics, f"{base}.errors", load.get("errors"))
+    _put(metrics, f"{base}.untyped", load.get("untyped_errors"))
+
+
 def _from_bulk(record: dict, metrics: dict) -> None:
     """BENCH_BULK_r01 / bench_serve --bulk: best shard plan throughput."""
     best = None
@@ -238,6 +254,8 @@ def extract_metrics(record: dict) -> dict[str, float]:
         _from_serve_async(record, metrics)
     elif bench == "serve_chaos":
         _from_serve_chaos(record, metrics)
+    elif bench == "serve_traffic":
+        _from_serve_traffic(record, metrics)
     elif bench == "bulk_scoring":
         _from_bulk(record, metrics)
     elif bench == "search_halving_vs_exhaustive":

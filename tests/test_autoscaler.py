@@ -1,0 +1,606 @@
+"""Load-adaptive fleet: the autoscaler control loop, the brownout ladder,
+runtime resize, admission rescale, and the synthetic traffic generator.
+
+Pins the PR's guarantees:
+
+- the control loop scales UP on a fast-burning SLO (build from artifact +
+  smoke + admit through `add_replica`) and the new replica takes traffic;
+- the scale-up cooldown prevents flapping: a burn inside the cooldown
+  engages the brownout ladder instead of adding another replica;
+- scale-down needs ``stable_ticks`` consecutive idle evaluations plus both
+  cooldowns, retires only the tail, and NEVER goes below one routable
+  replica — no signal combination can darken the fleet;
+- brownout rungs engage strictly in declared order and release strictly in
+  reverse, one rung per tick, before any capacity is retired; the serving
+  hooks honor each rung (canary taps off, ``degraded: true`` without SHAP
+  and without persisting `model.shap_error`, bulk shed, full shed);
+- a resize mid-traffic loses zero in-flight requests (drain before pop;
+  stragglers finish against the retired object);
+- `AdmissionController.rescale` recomputes the fleet's in-flight cap and
+  token bucket on every resize, and `ReplicaSet` calls it from both resize
+  paths;
+- `reliability.traffic` schedules are pure functions of the seed;
+- the operator plane (``POST /admin/autoscaler``) and the ``/readyz``
+  autoscaler/brownout blocks work over live HTTP.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import random
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cobalt_smart_lender_ai_tpu.config import ServeConfig
+from cobalt_smart_lender_ai_tpu.data import schema
+from cobalt_smart_lender_ai_tpu.reliability.admission import (
+    AdmissionController,
+    TokenBucket,
+)
+from cobalt_smart_lender_ai_tpu.reliability.errors import (
+    RequestShed,
+    ValidationError,
+)
+from cobalt_smart_lender_ai_tpu.reliability.traffic import (
+    KINDS,
+    TenantPopulation,
+    TrafficGenerator,
+    bursty,
+    shape_by_name,
+    steady,
+)
+from cobalt_smart_lender_ai_tpu.serve.autoscaler import (
+    BROWNOUT_RUNGS,
+    LEVEL_NO_SHAP,
+    LEVEL_SHED_ALL,
+    BrownoutLadder,
+    brownout_gate,
+)
+from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
+from cobalt_smart_lender_ai_tpu.serve.replicas import ReplicaSet
+from cobalt_smart_lender_ai_tpu.serve.service import SINGLE_INPUT_FIELDS
+
+
+def _cfg(**kw) -> ServeConfig:
+    """Autoscaled fleet config tuned for fast tests: no prewarm, no score
+    cache, snappy supervisor, autoscaler enabled with small cooldowns the
+    fake clock steps over explicitly."""
+    base = dict(
+        replicas=2,
+        microbatch_enabled=False,
+        precompile_batch_buckets=(),
+        prewarm_all_buckets=False,
+        score_cache_size=0,
+        supervisor_probe_deadline_s=0.3,
+        supervisor_probe_failures=1,
+        supervisor_drain_timeout_s=1.0,
+        replica_close_timeout_s=2.0,
+        autoscaler_enabled=True,
+        autoscaler_min_replicas=1,
+        autoscaler_max_replicas=4,
+        autoscaler_scale_up_cooldown_s=5.0,
+        autoscaler_scale_down_cooldown_s=15.0,
+        autoscaler_stable_ticks=3,
+    )
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _payload() -> dict:
+    return {
+        canonical: 1 if canonical in schema.SERVING_INT_FEATURES else 1.5
+        for canonical in SINGLE_INPUT_FIELDS.values()
+    }
+
+
+class _FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _drive(scaler, **kw) -> None:
+    """Replace the signal read with a controlled classification; the
+    replica count stays live so resize decisions see their own effects."""
+    fleet = scaler.fleet
+
+    def fake_signals():
+        sig = {
+            "fast_burn": False,
+            "queue_wait_p95_ms": None,
+            "util": 0.0,
+            "queue_depth": 0,
+            "in_flight": 0,
+            "replicas": len(fleet.replicas),
+        }
+        sig.update(kw)
+        return sig
+
+    scaler._signals = fake_signals
+
+
+@contextlib.contextmanager
+def _serving(service):
+    server = make_async_server(service)
+    try:
+        yield f"http://127.0.0.1:{server.port}"
+    finally:
+        server.close()
+
+
+def _request(url, data=None):
+    req = urllib.request.Request(
+        url, data=data, method="POST" if data is not None else "GET"
+    )
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# --- the control loop (fake clock, controlled signals) ------------------------
+
+
+def test_scale_up_on_fast_burn(serving_artifact):
+    store, _ = serving_artifact
+    clock = _FakeClock()
+    fleet = ReplicaSet.from_store(store, _cfg(), clock=clock)
+    try:
+        scaler = fleet.autoscaler
+        assert scaler is not None and not scaler.running
+        _drive(scaler, fast_burn=True)
+        summary = scaler.tick()
+        assert "scale_up" in summary["actions"]
+        assert len(fleet.replicas) == 3
+        assert int(scaler._m_resizes.labels(direction="up").value) == 1
+        # the admitted replica takes traffic through the fleet router
+        for _ in range(12):
+            resp = fleet.predict_single(_payload())
+            assert 0.0 <= resp["prob_default"] <= 1.0
+        # and its per-slot gauge family exists (stable labels)
+        assert fleet._g_state.labels(replica="2").value is not None
+    finally:
+        fleet.close()
+
+
+def test_cooldown_prevents_flapping_and_engages_brownout(serving_artifact):
+    store, _ = serving_artifact
+    clock = _FakeClock()
+    fleet = ReplicaSet.from_store(store, _cfg(), clock=clock)
+    try:
+        scaler = fleet.autoscaler
+        _drive(scaler, fast_burn=True)
+        assert "scale_up" in scaler.tick()["actions"]
+        # Inside the cooldown a burning SLO must not add another replica —
+        # the ladder absorbs the overload instead.
+        summary = scaler.tick()
+        assert "scale_up" not in summary["actions"]
+        assert f"brownout:{BROWNOUT_RUNGS[1]}" in summary["actions"]
+        assert len(fleet.replicas) == 3
+        # past the cooldown the next burn tick scales again
+        clock.advance(5.1)
+        assert "scale_up" in scaler.tick()["actions"]
+        assert len(fleet.replicas) == 4
+    finally:
+        fleet.close()
+
+
+def test_scale_down_needs_stable_idle_and_stops_at_floor(serving_artifact):
+    store, _ = serving_artifact
+    clock = _FakeClock()
+    fleet = ReplicaSet.from_store(store, _cfg(replicas=3), clock=clock)
+    try:
+        scaler = fleet.autoscaler
+        _drive(scaler)  # idle
+        assert "scale_down" not in scaler.tick()["actions"]  # idle_ticks=1
+        assert "scale_down" not in scaler.tick()["actions"]  # idle_ticks=2
+        assert "scale_down" in scaler.tick()["actions"]  # stable_ticks met
+        assert len(fleet.replicas) == 2
+        # the scale-down cooldown holds the next retire
+        for _ in range(5):
+            assert "scale_down" not in scaler.tick()["actions"]
+        clock.advance(15.1)
+        # idle evidence kept accumulating through the cooldown: first cooled
+        # tick retires the next tail replica
+        assert "scale_down" in scaler.tick()["actions"]
+        assert len(fleet.replicas) == 1
+        # the floor: no amount of idle evidence retires the last replica
+        clock.advance(15.1)
+        for _ in range(6):
+            assert "scale_down" not in scaler.tick()["actions"]
+        assert len(fleet.replicas) == 1
+    finally:
+        fleet.close()
+
+
+def test_remove_replica_never_darkens_the_fleet(serving_artifact):
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(store, _cfg())
+    try:
+        # tail quarantined -> being healed -> refuse to retire it
+        fleet.quarantine_replica(1, reason="drill")
+        with pytest.raises(ValidationError):
+            fleet.remove_replica()
+        fleet.readmit_replica(1)
+        # head quarantined -> tail is the last routable replica -> refuse
+        fleet.quarantine_replica(0, reason="drill")
+        with pytest.raises(ValidationError):
+            fleet.remove_replica()
+    finally:
+        fleet.close()
+
+
+# --- the brownout ladder ------------------------------------------------------
+
+
+def test_brownout_rungs_walk_in_declared_order():
+    ladder = BrownoutLadder()
+    seen = []
+    while True:
+        step = ladder.engage("test")
+        if step is None:
+            break
+        seen.append(BROWNOUT_RUNGS[step[1]])
+    assert seen == list(BROWNOUT_RUNGS[1:])  # healthy excluded, order exact
+    assert ladder.level == LEVEL_SHED_ALL
+    released = []
+    while True:
+        step = ladder.release("test")
+        if step is None:
+            break
+        released.append(BROWNOUT_RUNGS[step[0]])
+    assert released == list(reversed(BROWNOUT_RUNGS[1:]))  # strict reverse
+    assert ladder.level == 0
+    assert ladder.engaged_total == ladder.released_total == 5
+
+
+def test_brownout_max_level_caps_the_ladder():
+    ladder = BrownoutLadder(max_level=3)
+    for _ in range(10):
+        ladder.engage("test")
+    assert ladder.level == 3  # never reaches the shed rungs
+
+
+def test_brownout_gate_sheds_bulk_before_single():
+    ladder = BrownoutLadder()
+    ladder.level = 4  # shed_bulk
+    with pytest.raises(RequestShed):
+        brownout_gate(ladder, "bulk")
+    brownout_gate(ladder, "single")  # still served
+    ladder.level = 5  # shed_all
+    with pytest.raises(RequestShed):
+        brownout_gate(ladder, "single")
+    brownout_gate(None, "bulk")  # bare service: no ladder, no gate
+
+
+def test_ladder_releases_fully_before_any_retire(serving_artifact):
+    store, _ = serving_artifact
+    clock = _FakeClock()
+    fleet = ReplicaSet.from_store(
+        store, _cfg(autoscaler_max_replicas=2), clock=clock
+    )
+    try:
+        scaler = fleet.autoscaler
+        _drive(scaler, fast_burn=True)
+        scaler.tick()  # at the ceiling: engage, not scale
+        scaler.tick()
+        assert fleet.brownout.level == 2
+        # burn clears into full idle; recovery must come before savings
+        _drive(scaler)
+        clock.advance(20.0)  # every cooldown long since expired
+        s1 = scaler.tick()
+        assert f"brownout_release:{BROWNOUT_RUNGS[1]}" in s1["actions"]
+        assert "scale_down" not in s1["actions"]
+        s2 = scaler.tick()
+        assert f"brownout_release:{BROWNOUT_RUNGS[0]}" in s2["actions"]
+        assert "scale_down" not in s2["actions"]
+        assert fleet.brownout.level == 0
+        for _ in range(3):
+            summary = scaler.tick()
+        assert "scale_down" in summary["actions"]
+    finally:
+        fleet.close()
+
+
+def test_brownout_shap_shed_degrades_without_persisting(serving_artifact):
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(store, _cfg(brownout_max_level=5))
+    try:
+        payload = _payload()
+        healthy = fleet.predict_single(payload)
+        assert healthy["shap_values"] is not None
+        assert "degraded" not in healthy
+
+        fleet.brownout.level = LEVEL_NO_SHAP
+        resp = fleet.predict_single(payload)
+        assert resp["degraded"] is True
+        assert resp["shap_values"] is None and resp["base_value"] is None
+        # transient shed, not a broken program: nothing persisted
+        assert all(rep._model.shap_error is None for rep in fleet.replicas)
+
+        fleet.brownout.level = 0
+        recovered = fleet.predict_single(payload)
+        assert recovered["shap_values"] is not None
+        assert "degraded" not in recovered
+        ok, _ = fleet.ready()
+        assert ok
+    finally:
+        fleet.close()
+
+
+def test_brownout_shed_rungs_429_the_scoring_plane(serving_artifact):
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(store, _cfg(brownout_max_level=5))
+    try:
+        csv_bytes = (
+            ",".join(_payload()) + "\n"
+            + ",".join(str(v) for v in _payload().values()) + "\n"
+        ).encode()
+        fleet.brownout.level = 4  # shed_bulk
+        with pytest.raises(RequestShed):
+            fleet.predict_bulk_csv(csv_bytes)
+        with pytest.raises(RequestShed):
+            fleet.feature_importance_bulk({"data": [_payload()]})
+        fleet.predict_single(_payload())  # single-row still serves
+        fleet.brownout.level = 5  # shed_all
+        with pytest.raises(RequestShed):
+            fleet.predict_single(_payload())
+        fleet.brownout.level = 0
+        assert fleet.predict_single(_payload())["prob_default"] >= 0.0
+    finally:
+        fleet.close()
+
+
+# --- resize under live traffic ------------------------------------------------
+
+
+def test_resize_mid_traffic_loses_zero_requests(serving_artifact):
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(store, _cfg())
+    errors: list[BaseException] = []
+    done = threading.Event()
+
+    def hammer():
+        payload = _payload()
+        while not done.is_set():
+            try:
+                resp = fleet.predict_single(payload)
+                assert 0.0 <= resp["prob_default"] <= 1.0
+            except BaseException as exc:  # noqa: BLE001 - the assertion
+                errors.append(exc)
+                return
+
+    threads = [threading.Thread(target=hammer, daemon=True) for _ in range(6)]
+    try:
+        for t in threads:
+            t.start()
+        scaler = fleet.autoscaler
+        for _ in range(2):  # grow 2 -> 4 under load
+            assert scaler._scale_up()
+        for _ in range(3):  # shrink 4 -> 1 under load (drain before pop)
+            result = fleet.remove_replica()
+            assert result["status"] == "retired"
+        assert len(fleet.replicas) == 1
+    finally:
+        done.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        fleet.close()
+    assert errors == []
+
+
+# --- admission rescale --------------------------------------------------------
+
+
+def test_token_bucket_resize_refills_then_clamps():
+    clock = _FakeClock()
+    bucket = TokenBucket(rate_rps=10.0, burst=10, clock=clock)
+    for _ in range(10):
+        assert bucket.try_acquire()
+    assert not bucket.try_acquire()  # drained
+    clock.advance(0.5)  # 5 tokens accrue at the OLD rate
+    bucket.resize(rate_rps=20.0, burst=4)  # refill first, then clamp to 4
+    for _ in range(4):
+        assert bucket.try_acquire()
+    assert not bucket.try_acquire()
+    clock.advance(0.2)  # the NEW rate: 20/s * 0.2s = 4 tokens
+    for _ in range(4):
+        assert bucket.try_acquire()
+    with pytest.raises(ValueError):
+        bucket.resize(rate_rps=0.0, burst=4)
+
+
+def test_admission_rescale_multiplies_base_capacity():
+    adm = AdmissionController(max_in_flight=4, rate_rps=10.0, burst=10)
+    out = adm.rescale(3)
+    assert out == {"units": 3, "max_in_flight": 12, "rate_rps": 30.0}
+    assert adm.stats()["max_in_flight"] == 12
+    assert adm.stats()["scale_units"] == 3
+    # back down: capacity follows the fleet, floored at one unit
+    adm.rescale(0)
+    assert adm.max_in_flight == 4
+    # unlimited knobs stay unlimited at any scale
+    free = AdmissionController(max_in_flight=None, rate_rps=None)
+    free.rescale(5)
+    assert free.max_in_flight is None
+
+
+def test_fleet_resize_recomputes_admission(serving_artifact):
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(store, _cfg())
+    try:
+        base = fleet.admission._base_max_in_flight
+        assert fleet.admission.max_in_flight == base * 2
+        assert fleet.autoscaler._scale_up()
+        assert fleet.admission.max_in_flight == base * 3
+        fleet.remove_replica()
+        assert fleet.admission.max_in_flight == base * 2
+    finally:
+        fleet.close()
+
+
+# --- the traffic generator ----------------------------------------------------
+
+
+def _tenants() -> TenantPopulation:
+    return TenantPopulation(["a", "b", "c"], ["b"], n_tenants=8, seed=3)
+
+
+def test_schedule_is_a_pure_function_of_the_seed():
+    def gen(seed):
+        return TrafficGenerator(
+            shape_by_name("flash_crowd"),
+            base_rps=5.0,
+            peak_rps=80.0,
+            duration_s=10.0,
+            tenants=_tenants(),
+            seed=seed,
+        )
+
+    a, b = gen(7).schedule(), gen(7).schedule()
+    assert a == b  # replayable: same seed, identical arrivals
+    assert gen(8).schedule() != a
+    assert all(x.t <= y.t for x, y in zip(a, a[1:]))  # sorted by fire time
+    assert {x.kind for x in a} <= set(KINDS)
+    assert all(0.0 <= x.t < 10.0 for x in a)
+
+
+def test_flash_crowd_shape_spikes_and_decays():
+    shape = shape_by_name("flash_crowd")
+    assert shape.at(0.1) == pytest.approx(0.05)
+    assert shape.at(0.4) == 1.0  # plateau
+    assert shape.at(0.99) < 0.15  # decayed back toward baseline
+    gen = TrafficGenerator(
+        shape,
+        base_rps=10.0,
+        peak_rps=100.0,
+        duration_s=100.0,
+        tenants=_tenants(),
+    )
+    assert gen.target_rps(10.0) == pytest.approx(14.5)
+    assert gen.target_rps(40.0) == pytest.approx(100.0)
+
+
+def test_shapes_compose_and_unknown_names_fail_loudly():
+    combo = (steady(1.0) + bursty(seed=1)).scaled(0.5)
+    assert 0.0 <= combo.at(0.5) <= 1.0
+    with pytest.raises(ValueError):
+        shape_by_name("tsunami")
+    with pytest.raises(ValueError):
+        TrafficGenerator(
+            steady(),
+            base_rps=10.0,
+            peak_rps=5.0,  # peak < base
+            duration_s=1.0,
+            tenants=_tenants(),
+        )
+    with pytest.raises(ValueError):
+        TrafficGenerator(
+            steady(),
+            base_rps=1.0,
+            peak_rps=2.0,
+            duration_s=1.0,
+            tenants=_tenants(),
+            mix={"telepathy": 1.0},
+        )
+
+
+def test_tenant_population_zipf_weights_and_payload_jitter():
+    pop = _tenants()
+    rng = random.Random(0)
+    picks = [pop.pick(rng) for _ in range(4000)]
+    assert picks.count(0) > picks.count(7) * 2  # hot head, cold tail
+    row = pop.payload(2, random.Random(1))
+    assert set(row) == {"a", "b", "c"}
+    assert row["b"] in (0, 1)  # int fields never jitter
+    # caller-supplied base rows are used verbatim (cycled over tenants)
+    real = TenantPopulation(
+        ["a", "b"], base_rows=[{"a": 1.0, "b": 2.0}], jitter=0.0, n_tenants=3
+    )
+    assert real.payload(2, random.Random(2)) == {"a": 1.0, "b": 2.0}
+
+
+# --- the operator plane over live HTTP ---------------------------------------
+
+
+def test_admin_autoscaler_and_readyz_blocks_over_http(serving_artifact):
+    store, _ = serving_artifact
+    fleet = ReplicaSet.from_store(
+        store, _cfg(autoscaler_interval_s=30.0)  # loop idles during the test
+    )
+    try:
+        with _serving(fleet) as url:
+            status, body = _request(f"{url}/readyz")
+            assert status == 200
+            ready = json.loads(body)
+            assert ready["brownout"]["rung"] == "healthy"
+            assert ready["autoscaler"]["enabled"] is True
+            assert ready["autoscaler"]["running"] is True  # socket-open hook
+            assert ready["autoscaler"]["replicas"] == 2
+
+            status, body = _request(
+                f"{url}/admin/autoscaler",
+                json.dumps({"action": "pause"}).encode(),
+            )
+            assert status == 200 and json.loads(body)["status"] == "paused"
+            assert fleet.autoscaler.tick() == {"status": "paused"}
+
+            status, body = _request(
+                f"{url}/admin/autoscaler",
+                json.dumps({"action": "force", "replicas": 3}).encode(),
+            )
+            assert status == 200
+            out = json.loads(body)
+            assert out["replicas"] == 3 and out["steps"] == ["up"]
+            assert len(fleet.replicas) == 3
+
+            status, body = _request(
+                f"{url}/admin/autoscaler",
+                json.dumps({"action": "force", "replicas": 99}).encode(),
+            )
+            assert status == 422  # bounds still apply to operators
+
+            status, body = _request(
+                f"{url}/admin/autoscaler",
+                json.dumps({"action": "resume"}).encode(),
+            )
+            assert status == 200 and json.loads(body)["status"] == "resumed"
+
+            status, body = _request(
+                f"{url}/admin/autoscaler",
+                json.dumps({"action": "explode"}).encode(),
+            )
+            assert status == 422
+    finally:
+        fleet.close()
+
+
+def test_admin_autoscaler_422_on_bare_service(serving_artifact):
+    store, _ = serving_artifact
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    service = ScorerService.from_store(
+        store, _cfg(replicas=1, autoscaler_enabled=False)
+    )
+    try:
+        with _serving(service) as url:
+            status, body = _request(
+                f"{url}/admin/autoscaler",
+                json.dumps({"action": "status"}).encode(),
+            )
+            assert status == 422
+            assert json.loads(body)["error"] == "invalid_input"
+    finally:
+        service.close()

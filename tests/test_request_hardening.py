@@ -274,6 +274,8 @@ def test_admission_capacity_shed_and_release():
         "admitted": 3,
         "shed_rate": 0,
         "shed_capacity": 1,
+        "max_in_flight": 2,
+        "scale_units": 1,
     }
 
 

@@ -212,6 +212,7 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
         "/admin/rollback",
         "/admin/quarantine",
         "/admin/readmit",
+        "/admin/autoscaler",
     }
     assert set(app.get_routes) == {
         "/healthz",
