@@ -503,6 +503,21 @@ class ServeConfig:
     #: may go (2 = never sheds; 4 = bulk 429s; 5 = full 429).
     brownout_enabled: bool = True
     brownout_max_level: int = 3
+    #: Scoring kernels (ops/score_pallas.py, README "Scoring kernels &
+    #: precision"). ``fused_kernels`` routes every serving compile through
+    #: the one-pass Pallas kernel (traversal + margin + sigmoid + SHAP in
+    #: ONE dispatch); f32 fused margins are bit-identical to the reference
+    #: contraction, so this is on by default (``--reference-kernels`` /
+    #: ``COBALT_REFERENCE_KERNELS=1`` opts out). ``forest_precision`` picks
+    #: the packed forest representation — "f32" (default, exact), "bf16",
+    #: or "int8" (affine scale/zero-point tables built at publish time).
+    #: Quantized precisions require the fused kernel, are gated at model
+    #: build by the committed tolerance contract
+    #: (score_pallas.PRECISION_TOLERANCES), and key the score cache and
+    #: executable cache by precision + table hash so a hot reload that
+    #: flips precision can never alias responses.
+    fused_kernels: bool = True
+    forest_precision: str = "f32"
     reliability: ReliabilityConfig = dataclasses.field(
         default_factory=ReliabilityConfig
     )

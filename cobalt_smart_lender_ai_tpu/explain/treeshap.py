@@ -91,6 +91,14 @@ def _bilinear_kernel(depth: int) -> np.ndarray:
     return Wt
 
 
+# Public aliases: the fused scoring kernel (ops/score_pallas.py) reuses the
+# static path structure and the Shapley bilinear form so both SHAP programs
+# share one definition of the math.
+path_structure = _path_structure
+shapley_kernel = _shapley_kernel
+bilinear_kernel = _bilinear_kernel
+
+
 @partial(jax.jit, static_argnames=("n_features",))
 def shap_values(
     forest: Forest, X: jax.Array, *, n_features: int

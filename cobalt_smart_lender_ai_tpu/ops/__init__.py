@@ -6,6 +6,13 @@ from cobalt_smart_lender_ai_tpu.ops.binning import (
     transform,
 )
 from cobalt_smart_lender_ai_tpu.ops.histogram import gradient_histogram
+from cobalt_smart_lender_ai_tpu.ops.score_pallas import (
+    ForestPack,
+    fused_score,
+    kernel_mode,
+    pack_forest,
+    set_kernel_mode,
+)
 from cobalt_smart_lender_ai_tpu.ops.metrics import (
     binary_classification_report,
     confusion_matrix,
@@ -18,6 +25,11 @@ __all__ = [
     "compute_bin_edges",
     "transform",
     "gradient_histogram",
+    "ForestPack",
+    "fused_score",
+    "kernel_mode",
+    "pack_forest",
+    "set_kernel_mode",
     "roc_auc",
     "confusion_matrix",
     "precision_recall_f1",

@@ -1,0 +1,684 @@
+"""Fused Pallas scoring kernel: traversal + margin + sigmoid + SHAP in ONE pass.
+
+The serving hot path used to issue two device programs per micro-batch — the
+margin contraction (`models.gbdt.predict_margin`) and the TreeSHAP program
+(`explain.treeshap.shap_values`) — and BENCH_SERVE_r03 showed that pair
+(~2.6 ms/cycle on the bench host) is the congestion floor once scheduling is
+tuned. Both programs walk the same forest and compute the same per-leaf
+walk indicators; this kernel fuses them so one `pallas_call` per batch:
+
+- descends every tree once (the ``ind`` walk-indicator tensor is shared by
+  the margin reduction and the SHAP polynomial),
+- accumulates the margin in the same sequential tree order as the reference
+  `lax.scan` (bit-identical f32 margins — the selected leaf's value is picked
+  by an exact 0/1 mask product, and adding exact zeros is order-invariant),
+- applies the logistic in-kernel (`jax.nn.sigmoid`, the same op the batcher
+  used host-side), and
+- runs the leaf-polynomial Shapley contraction of `explain.treeshap` on the
+  shared indicators, scattering per-feature contributions through an exact
+  0/1 one-hot matmul (MXU-friendly on TPU; SHAP is tolerance-gated, not
+  bit-gated, so the reduction-order change is inside the contract).
+
+Like `ops.hist_pallas`, the kernel carries an ``interpret=`` lowering so the
+same program runs (and is parity-tested) on CPU CI; `default_interpret()`
+resolves it from the active backend. The grid iterates over row blocks with
+the forest tensors resident as constant VMEM blocks — the supported envelope
+is serving-sized forests (see `fused_supported`), which is exactly the
+artifact class `ServeConfig` ships.
+
+Low-precision forests
+---------------------
+
+`pack_forest` builds the kernel's input bundle — a `ForestPack` — at
+artifact-publish time, in f32 (pass-through), bf16, or int8:
+
+- **bf16**: thresholds and leaf values stored as bf16, widened in-kernel.
+- **int8**: thresholds quantized per *feature* (affine scale/zero-point over
+  that feature's finite split thresholds), leaf values per *tree*; the
+  scale/zero tables ride the pack and dequantization happens inside the
+  kernel, so the HBM-resident forest is genuinely 8-bit.
+
+Trivial (non-)splits resolve to ``+inf`` thresholds in the f32 forest
+(all-left); quantized encodings cannot represent that, so the pack carries an
+explicit ``all_left`` mask that forces the left branch for non-NaN values —
+a no-op under f32 (``x <= +inf`` is already True), which preserves the
+bit-parity contract.
+
+Every non-f32 pack is gated at publish against `PRECISION_TOLERANCES` on a
+deterministic probe matrix derived from the forest's own thresholds
+(`quantization_report`): a quantization that moves probe margins/probabilities
+beyond the committed bound never serves. The pack's ``table_hash`` (md5 of
+the quantized tensors + tables) keys the executable cache and the score
+cache so f32 and int8 responses can never alias across a hot reload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# NOTE: explain.treeshap (for the shared path structure / Shapley bilinear
+# form) and models.gbdt (for the reference margin in the publish gate) are
+# imported lazily inside the functions that need them: models.gbdt itself
+# imports ops.* submodules, so a module-level import here would be a cycle.
+
+__all__ = [
+    "PRECISIONS",
+    "PRECISION_TOLERANCES",
+    "ForestPack",
+    "default_interpret",
+    "fused_score",
+    "fused_supported",
+    "kernel_mode",
+    "pack_forest",
+    "quantization_report",
+    "set_kernel_mode",
+]
+
+PRECISIONS = ("f32", "bf16", "int8")
+
+#: Committed publish-time tolerance contract for the quantized paths,
+#: measured against the f32 forest on the probe matrix of
+#: `quantization_report` (rows deliberately straddling every feature's own
+#: thresholds — the worst case for routing flips). Individual
+#: boundary-sitting rows CAN flip to a sibling leaf under any threshold
+#: quantization — that is inherent, so the max-delta bound is a loose
+#: catastrophe ceiling (a broken scale/zero table shifts every row, not a
+#: few) while the mean bounds carry the calibration contract; rank quality
+#: is separately gated by the AUC-preservation test in
+#: tests/test_score_kernel.py. Measured probe means on serving-sized
+#: forests: bf16 <= 0.084, int8 <= 0.141 margin units (~2.5x headroom
+#: committed). A pack exceeding its bound raises at
+#: `pack_forest(..., check=True)` / model build and never serves. f32 is
+#: the bit-exact anchor (zero tolerance by construction, for symmetry).
+PRECISION_TOLERANCES: dict[str, dict[str, float]] = {
+    "f32": {
+        "mean_abs_margin_delta": 0.0,
+        "max_abs_margin_delta": 0.0,
+        "mean_abs_prob_delta": 0.0,
+    },
+    "bf16": {
+        "mean_abs_margin_delta": 0.25,
+        "max_abs_margin_delta": 4.0,
+        "mean_abs_prob_delta": 0.05,
+    },
+    "int8": {
+        "mean_abs_margin_delta": 0.40,
+        "max_abs_margin_delta": 4.0,
+        "mean_abs_prob_delta": 0.08,
+    },
+}
+
+#: Process-wide kernel-mode override; None resolves from the environment.
+_KERNEL_MODE: str | None = None
+
+
+def set_kernel_mode(mode: str | None) -> None:
+    """Force ``"fused"`` / ``"reference"`` process-wide (None = re-resolve
+    from ``COBALT_REFERENCE_KERNELS``). The serve CLI's
+    ``--reference-kernels`` flag lands here so every in-process compile site
+    — serving buckets, bulk, scenario — follows one switch."""
+    global _KERNEL_MODE
+    if mode is not None and mode not in ("fused", "reference"):
+        raise ValueError(f"kernel mode must be fused|reference, got {mode!r}")
+    _KERNEL_MODE = mode
+
+
+def kernel_mode() -> str:
+    """Active default scoring kernel: fused unless opted out via
+    `set_kernel_mode("reference")` or ``COBALT_REFERENCE_KERNELS=1``."""
+    if _KERNEL_MODE is not None:
+        return _KERNEL_MODE
+    if os.environ.get("COBALT_REFERENCE_KERNELS", "").lower() in (
+        "1",
+        "true",
+        "yes",
+    ):
+        return "reference"
+    return "fused"
+
+
+def default_interpret() -> bool:
+    """Interpret-mode resolution, `hist_pallas` convention: run the kernel
+    through the Pallas interpreter everywhere but real TPU backends."""
+    return jax.default_backend() != "tpu"
+
+
+@dataclasses.dataclass(frozen=True)
+class ForestPack:
+    """Precision-tagged forest bundle the fused kernel consumes.
+
+    Tensor layout mirrors `models.gbdt.Forest` (T complete trees, I internal
+    nodes, L leaves) with the threshold/leaf tensors stored at ``precision``
+    and their dequantization tables alongside (identity tables for
+    f32/bf16). ``all_left`` marks trivial splits whose f32 threshold is
+    ``+inf`` — routing metadata the quantized encodings cannot carry in-band.
+    Registered as a pytree with (depth, precision, table_hash) static, so
+    the partitioner's `_forest_fingerprint` — and therefore the executable
+    cache key — distinguishes packs by precision AND quantization table.
+    """
+
+    feature: jax.Array  # (T, I) int32
+    thr_q: jax.Array  # (T, I) f32 | bf16 | int8
+    missing_left: jax.Array  # (T, I) bool
+    all_left: jax.Array  # (T, I) bool — trivial splits (f32 thr == +inf)
+    cover: jax.Array  # (T, I + L) f32 — SHAP cover ratios stay f32
+    leaf_q: jax.Array  # (T, L) f32 | bf16 | int8
+    thr_scale: jax.Array  # (1, F) f32 — per-feature threshold scale
+    thr_zero: jax.Array  # (1, F) f32 — per-feature threshold zero point
+    leaf_scale: jax.Array  # (1, T) f32 — per-tree leaf scale
+    leaf_zero: jax.Array  # (1, T) f32 — per-tree leaf zero point
+    depth: int = dataclasses.field(metadata={"static": True})
+    precision: str = dataclasses.field(metadata={"static": True})
+    table_hash: str = dataclasses.field(metadata={"static": True})
+
+    @property
+    def n_trees(self) -> int:
+        return self.feature.shape[0]
+
+    @property
+    def n_features(self) -> int:
+        return self.thr_scale.shape[1]
+
+
+jax.tree_util.register_dataclass(
+    ForestPack,
+    data_fields=[
+        "feature",
+        "thr_q",
+        "missing_left",
+        "all_left",
+        "cover",
+        "leaf_q",
+        "thr_scale",
+        "thr_zero",
+        "leaf_scale",
+        "leaf_zero",
+    ],
+    meta_fields=["depth", "precision", "table_hash"],
+)
+
+
+def _per_feature_thr_tables(
+    feature: np.ndarray, thr: np.ndarray, n_features: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-feature affine tables over each feature's *finite* thresholds."""
+    lo = np.full(n_features, np.inf, np.float64)
+    hi = np.full(n_features, -np.inf, np.float64)
+    finite = np.isfinite(thr)
+    np.minimum.at(lo, feature[finite], thr[finite])
+    np.maximum.at(hi, feature[finite], thr[finite])
+    seen = np.isfinite(lo)
+    lo = np.where(seen, lo, 0.0)
+    hi = np.where(seen, hi, 0.0)
+    span = hi - lo
+    scale = np.where(span > 0, span / 254.0, 1.0)
+    zero = (hi + lo) / 2.0
+    return scale.astype(np.float32), zero.astype(np.float32)
+
+
+def _quantize_affine(
+    values: np.ndarray, scale: np.ndarray, zero: np.ndarray
+) -> np.ndarray:
+    q = np.round((values - zero) / scale)
+    return np.clip(q, -127, 127).astype(np.int8)
+
+
+def pack_forest(
+    forest: Any, n_features: int, precision: str = "f32", *, check: bool = True
+) -> ForestPack:
+    """Build the fused kernel's input bundle from a trained `Forest` — the
+    artifact-publish-time step (`_CompiledModel` runs it once per model, the
+    partitioner runs it implicitly for raw-forest callers).
+
+    ``check`` gates every non-f32 pack against `PRECISION_TOLERANCES` via
+    `quantization_report`, raising ``ValueError`` on violation so a bad
+    quantization is rejected before it can serve."""
+    if precision not in PRECISIONS:
+        raise ValueError(
+            f"forest_precision must be one of {PRECISIONS}, got {precision!r}"
+        )
+    feature = np.asarray(forest.feature, np.int32)
+    thr = np.asarray(forest.thr_float, np.float32)
+    ml = np.asarray(forest.missing_left, bool)
+    cover = np.asarray(forest.cover, np.float32)
+    leaf = np.asarray(forest.leaf_value, np.float32)
+    T = thr.shape[0]
+    all_left = np.isposinf(thr)
+    thr_scale = np.ones((1, n_features), np.float32)
+    thr_zero = np.zeros((1, n_features), np.float32)
+    leaf_scale = np.ones((1, T), np.float32)
+    leaf_zero = np.zeros((1, T), np.float32)
+    if precision == "f32":
+        thr_q: np.ndarray = thr
+        leaf_q: np.ndarray = leaf
+        # No table: the hash is the precision tag itself, a stable key
+        # element that still separates f32 from every quantized pack.
+        table_hash = "f32"
+    elif precision == "bf16":
+        thr_q = np.asarray(jnp.asarray(thr).astype(jnp.bfloat16))
+        leaf_q = np.asarray(jnp.asarray(leaf).astype(jnp.bfloat16))
+        table_hash = _table_hash(precision, thr_q, leaf_q)
+    else:  # int8
+        scale_f, zero_f = _per_feature_thr_tables(feature, thr, n_features)
+        thr_scale[0], thr_zero[0] = scale_f, zero_f
+        # Encode per node through its own feature's table; trivial (+inf)
+        # thresholds encode 0 — never read, ``all_left`` routes them.
+        node_scale = scale_f[feature]
+        node_zero = zero_f[feature]
+        thr_q = _quantize_affine(
+            np.where(all_left, node_zero, thr), node_scale, node_zero
+        )
+        lo_t = leaf.min(axis=1)
+        hi_t = leaf.max(axis=1)
+        span_t = hi_t - lo_t
+        leaf_scale[0] = np.where(span_t > 0, span_t / 254.0, 1.0)
+        leaf_zero[0] = (hi_t + lo_t) / 2.0
+        leaf_q = _quantize_affine(leaf, leaf_scale[0][:, None], leaf_zero[0][:, None])
+        table_hash = _table_hash(
+            precision, thr_q, leaf_q, thr_scale, thr_zero, leaf_scale, leaf_zero
+        )
+    pack = ForestPack(
+        feature=jnp.asarray(feature),
+        thr_q=jnp.asarray(thr_q),
+        missing_left=jnp.asarray(ml),
+        all_left=jnp.asarray(all_left),
+        cover=jnp.asarray(cover),
+        leaf_q=jnp.asarray(leaf_q),
+        thr_scale=jnp.asarray(thr_scale),
+        thr_zero=jnp.asarray(thr_zero),
+        leaf_scale=jnp.asarray(leaf_scale),
+        leaf_zero=jnp.asarray(leaf_zero),
+        depth=int(forest.depth),
+        precision=precision,
+        table_hash=table_hash,
+    )
+    if check and precision != "f32":
+        report = quantization_report(forest, pack, n_features)
+        if not report["within_tolerance"]:
+            raise ValueError(
+                f"{precision} quantization exceeds the committed tolerance "
+                f"contract: {report}"
+            )
+    return pack
+
+
+def _table_hash(precision: str, *arrays: np.ndarray) -> str:
+    h = hashlib.md5(precision.encode())
+    for a in arrays:
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def probe_rows(forest: Any, n_features: int, rows: int = 64) -> np.ndarray:
+    """Deterministic quantization probe matrix: rows straddle the forest's
+    own finite thresholds (the values where quantized routing can flip) at
+    ±1% offsets, plus an all-NaN row (missing-direction routing) and an
+    all-zeros row (the serving smoke row). No RNG — the publish gate must be
+    reproducible across hosts."""
+    thr = np.asarray(forest.thr_float, np.float32)
+    feature = np.asarray(forest.feature, np.int32)
+    per_feature: list[np.ndarray] = []
+    for f in range(n_features):
+        vals = np.unique(thr[(feature == f) & np.isfinite(thr)])
+        per_feature.append(vals if vals.size else np.zeros(1, np.float32))
+    n_body = max(rows - 2, 1)
+    X = np.zeros((n_body + 2, n_features), np.float32)
+    offsets = np.array([-0.01, 0.01, -0.03, 0.03], np.float32)
+    for f, vals in enumerate(per_feature):
+        idx = np.arange(n_body) % vals.size
+        off = offsets[np.arange(n_body) % offsets.size]
+        X[:n_body, f] = vals[idx] * (1.0 + off) + off
+    X[n_body] = np.nan
+    X[n_body + 1] = 0.0
+    return X
+
+
+def quantization_report(
+    forest: Any, pack: ForestPack, n_features: int
+) -> dict[str, Any]:
+    """Publish-gate comparison of a quantized pack against the f32 forest on
+    the deterministic probe matrix: mean/max |margin delta| and mean |prob
+    delta|, and whether all sit inside
+    `PRECISION_TOLERANCES[pack.precision]`."""
+    from cobalt_smart_lender_ai_tpu.models.gbdt import predict_margin
+
+    X = probe_rows(forest, n_features)
+    ref_margin = np.asarray(predict_margin(forest, jnp.asarray(X)))
+    margin, prob = fused_score(
+        pack,
+        jnp.asarray(X),
+        n_features=n_features,
+        with_shap=False,
+        interpret=default_interpret(),
+    )
+    dm = np.abs(np.asarray(margin) - ref_margin)
+    with np.errstate(over="ignore"):
+        ref_prob = 1.0 / (1.0 + np.exp(-ref_margin))
+    dp = np.abs(np.asarray(prob) - ref_prob)
+    tol = PRECISION_TOLERANCES[pack.precision]
+    report = {
+        "precision": pack.precision,
+        "probe_rows": int(X.shape[0]),
+        "mean_abs_margin_delta": float(dm.mean()),
+        "max_abs_margin_delta": float(dm.max()),
+        "mean_abs_prob_delta": float(dp.mean()),
+        "tolerance": dict(tol),
+    }
+    report["within_tolerance"] = all(
+        report[k] <= tol[k] for k in tol
+    )
+    return report
+
+
+def _row_block(rows: int, depth: int, with_shap: bool) -> int:
+    """Row-block size: the largest power of two that keeps the per-block
+    intermediates (the (R, L, d) indicator tensor; plus the two
+    (R, L, d, d+1) polynomial coefficient stacks under SHAP) inside a
+    ~48 MB budget, capped at the padded request size."""
+    L = 2**depth
+    per_row = L * depth * 4
+    if with_shap:
+        per_row += 2 * L * depth * (depth + 1) * 4 + 4 * L * depth * 4
+    budget = 48 << 20
+    r = max(1, budget // max(per_row, 1))
+    r = 1 << (int(r).bit_length() - 1)
+    cap = 1 << max(0, rows - 1).bit_length()
+    return max(1, min(r, cap))
+
+
+def fused_supported(n_trees: int, depth: int, n_features: int) -> bool:
+    """Shape guard mirroring `hist_pallas.pallas_supported`: the forest
+    tensors ride the grid as constant VMEM-resident blocks, so the packed
+    forest must stay a small fraction of the ~16 MB scoped VMEM budget."""
+    L = 2**depth
+    forest_bytes = n_trees * ((L - 1) * 11 + (L - 1 + L) * 4 + L * 4)
+    return depth <= 10 and forest_bytes <= (8 << 20) and n_features <= 4096
+
+
+def _score_kernel(
+    feature_ref,
+    thr_ref,
+    ml_ref,
+    al_ref,
+    cover_ref,
+    leaf_ref,
+    thr_scale_ref,
+    thr_zero_ref,
+    leaf_scale_ref,
+    leaf_zero_ref,
+    paths_ref,
+    dirs_ref,
+    child_ref,
+    wt_ref,
+    x_ref,
+    *out_refs,
+    depth: int,
+    n_features: int,
+    precision: str,
+    with_shap: bool,
+):
+    d = depth
+    L = 2**d
+    X = x_ref[:]  # (R, F)
+    R = X.shape[0]
+    # Static tree-structure tables (ancestor paths, branch directions, child
+    # heap slots, Shapley bilinear form) ride as constant-block inputs —
+    # Pallas kernels cannot close over array constants.
+    paths_c = paths_ref[:]
+    dirs_c = dirs_ref[:]
+    child_c = child_ref[:]
+    Wt_c = wt_ref[:]
+    pos_ids = jnp.arange(d, dtype=jnp.int32)
+    lower = jnp.tril(jnp.ones((d, d), bool))
+    feat_ids = jnp.arange(n_features, dtype=jnp.int32)
+    thr_scale = thr_scale_ref[0]  # (F,)
+    thr_zero = thr_zero_ref[0]
+    leaf_scale = leaf_scale_ref[0]  # (T,)
+    leaf_zero = leaf_zero_ref[0]
+
+    def one_tree(carry, tree):
+        feats, thr_q, ml, al, cov, leaf_q, lscale, lzero = tree
+        # In-kernel dequantization: the HBM/VMEM-resident forest stays at
+        # ``precision``; f32 is a static pass-through (bit parity).
+        if precision == "f32":
+            thr = thr_q
+            lv = leaf_q
+        else:
+            thr = thr_q.astype(jnp.float32)
+            lv = leaf_q.astype(jnp.float32)
+            if precision == "int8":
+                thr = thr * thr_scale[feats] + thr_zero[feats]
+                lv = lv * lscale + lzero
+        pf = feats[paths_c]  # (L, d) per-leaf ancestor features
+        pthr = thr[paths_c]
+        pml = ml[paths_c]
+        pal = al[paths_c]
+        xv = jnp.take(X, pf.reshape(-1), axis=1).reshape(R, L, d)
+        # Same per-node decision as the reference walk; ``| pal`` forces the
+        # all-left branch of trivial splits for non-NaN values — a no-op in
+        # f32 (x <= +inf already holds), required once +inf is quantized
+        # away. NaN keeps following the learned missing direction.
+        go_left = jnp.where(jnp.isnan(xv), pml[None], (xv <= pthr[None]) | pal[None])
+        ind = (go_left == dirs_c[None]).astype(jnp.float32)  # (R, L, d)
+        # Exactly one leaf per row has every ancestor comparison matching
+        # its path, so z_leaf is an exact one-hot over leaves: the margin
+        # reduction adds the landed leaf's value plus exact zeros — equal
+        # to the reference node-walk bit for bit, in the same tree order.
+        z_leaf = jnp.prod(ind, axis=2)  # (R, L)
+        margin_t = jnp.sum(z_leaf * lv[None, :], axis=1)  # (R,)
+        if not with_shap:
+            return carry + margin_t, None
+        margin, phis = carry
+        parent_cover = cov[paths_c]  # (L, d)
+        ratio = jnp.where(
+            parent_cover > 0,
+            cov[child_c] / jnp.maximum(parent_cover, 1e-30),
+            0.0,
+        )
+        # Identical player/slot algebra to `treeshap.shap_values`, with the
+        # row axis vectorized instead of vmapped (the walk indicators are
+        # already materialized for the margin above — the fusion win).
+        same = pf[:, :, None] == pf[:, None, :]  # (L, d, d)
+        slot = jnp.argmax(same & lower[None], axis=2).astype(jnp.int32)
+        member = slot[:, :, None] == pos_ids[None, None, :]  # (L, d, d)
+        r_play = jnp.prod(jnp.where(member, ratio[:, :, None], 1.0), axis=1)
+        z_play = jnp.prod(
+            jnp.where(member[None], ind[:, :, :, None], 1.0), axis=2
+        )  # (R, L, d)
+        e0 = jnp.zeros((R, L, d + 1), jnp.float32).at[:, :, 0].set(1.0)
+
+        def mul(c, j):
+            shifted = jnp.concatenate(
+                [jnp.zeros((R, L, 1), jnp.float32), c[:, :, :-1]], axis=2
+            )
+            return r_play[None, :, j, None] * c + z_play[:, :, j, None] * shifted
+
+        prefs = [e0]
+        for j in range(d - 1):
+            prefs.append(mul(prefs[-1], j))
+        sufs = [e0]
+        for j in range(d - 1, 0, -1):
+            sufs.append(mul(sufs[-1], j))
+        P = jnp.stack(prefs, axis=2)  # (R, L, d, d+1)
+        S = jnp.stack(sufs[::-1], axis=2)
+        psi = jnp.einsum(
+            "rlja,ab,rljb->rlj", P, Wt_c, S, precision=jax.lax.Precision.HIGHEST
+        )
+        contrib = (z_play - r_play[None]) * psi * lv[None, :, None]  # (R, L, d)
+        # Scatter-by-feature as an exact 0/1 one-hot matmul — the MXU
+        # formulation of the reference segment_sum.
+        onehot = (pf.reshape(-1)[:, None] == feat_ids[None, :]).astype(
+            jnp.float32
+        )  # (L*d, F)
+        phis = phis + jax.lax.dot_general(
+            contrib.reshape(R, L * d),
+            onehot,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return (margin + margin_t, phis), None
+
+    xs = (
+        feature_ref[:],
+        thr_ref[:],
+        ml_ref[:],
+        al_ref[:],
+        cover_ref[:],
+        leaf_ref[:],
+        leaf_scale,
+        leaf_zero,
+    )
+    if with_shap:
+        init = (jnp.zeros((R,), jnp.float32), jnp.zeros((R, n_features), jnp.float32))
+        (margin, phis), _ = jax.lax.scan(one_tree, init, xs)
+        out_refs[2][:] = phis
+    else:
+        margin, _ = jax.lax.scan(one_tree, jnp.zeros((R,), jnp.float32), xs)
+    out_refs[0][:] = margin[:, None]
+    out_refs[1][:] = jax.nn.sigmoid(margin)[:, None]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_features", "with_shap", "interpret")
+)
+def fused_score(
+    pack: ForestPack,
+    X: jax.Array,
+    *,
+    n_features: int,
+    with_shap: bool = True,
+    interpret: bool | None = None,
+):
+    """One fused dispatch over the forest.
+
+    Returns ``(margin, prob)`` with ``with_shap=False`` and
+    ``(margin, prob, phis, base)`` with it — shapes ``(N,)``, ``(N,)``,
+    ``(N, F)`` and a scalar. f32 margins are bit-identical to
+    `predict_margin`; ``prob`` is the in-kernel `jax.nn.sigmoid` of the
+    margin; ``phis``/``base`` match `shap_values` to float tolerance
+    (identical math, vectorized accumulation order). The base value is a
+    forest-only scalar, computed outside the kernel so the row grid never
+    recomputes it."""
+    from cobalt_smart_lender_ai_tpu.explain.treeshap import (
+        bilinear_kernel,
+        path_structure,
+    )
+
+    if interpret is None:
+        interpret = default_interpret()
+    d = pack.depth
+    L = 2**d
+    N = X.shape[0]
+    paths, dirs = path_structure(d)
+    child_heap = np.concatenate(
+        [paths[:, 1:], (np.arange(L, dtype=np.int32) + L - 1)[:, None]], axis=1
+    )
+    R = _row_block(N, d, with_shap)
+    N_pad = -(-N // R) * R
+    Xp = jnp.asarray(X, jnp.float32)
+    if N_pad != N:
+        Xp = jnp.pad(Xp, ((0, N_pad - N), (0, 0)))
+
+    def const_spec(shape):
+        nd = len(shape)
+        return pl.BlockSpec(
+            shape, lambda i, _n=nd: (0,) * _n, memory_space=pltpu.VMEM
+        )
+
+    in_specs = [
+        const_spec(pack.feature.shape),
+        const_spec(pack.thr_q.shape),
+        const_spec(pack.missing_left.shape),
+        const_spec(pack.all_left.shape),
+        const_spec(pack.cover.shape),
+        const_spec(pack.leaf_q.shape),
+        const_spec(pack.thr_scale.shape),
+        const_spec(pack.thr_zero.shape),
+        const_spec(pack.leaf_scale.shape),
+        const_spec(pack.leaf_zero.shape),
+        const_spec((L, d)),
+        const_spec((L, d)),
+        const_spec((L, d)),
+        const_spec((d + 1, d + 1)),
+        pl.BlockSpec((R, n_features), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((N_pad, 1), jnp.float32),
+        jax.ShapeDtypeStruct((N_pad, 1), jnp.float32),
+    ]
+    out_specs = [
+        pl.BlockSpec((R, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((R, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    ]
+    if with_shap:
+        out_shape.append(jax.ShapeDtypeStruct((N_pad, n_features), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec(
+                (R, n_features), lambda i: (i, 0), memory_space=pltpu.VMEM
+            )
+        )
+    outs = pl.pallas_call(
+        functools.partial(
+            _score_kernel,
+            depth=d,
+            n_features=n_features,
+            precision=pack.precision,
+            with_shap=with_shap,
+        ),
+        grid=(N_pad // R,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        pack.feature,
+        pack.thr_q,
+        pack.missing_left,
+        pack.all_left,
+        pack.cover,
+        pack.leaf_q,
+        pack.thr_scale,
+        pack.thr_zero,
+        pack.leaf_scale,
+        pack.leaf_zero,
+        jnp.asarray(paths),
+        jnp.asarray(dirs),
+        jnp.asarray(child_heap),
+        jnp.asarray(bilinear_kernel(d), jnp.float32),
+        Xp,
+    )
+    margin = outs[0][:N, 0]
+    prob = outs[1][:N, 0]
+    if not with_shap:
+        return margin, prob
+    phis = outs[2][:N]
+    # Forest-only expected margin (the SHAP base value), dequantized the
+    # same way the kernel does; summed over all trees at once — within the
+    # SHAP tolerance contract, and identical across single/mesh placements.
+    if pack.precision == "f32":
+        lv_all = pack.leaf_q
+    else:
+        lv_all = pack.leaf_q.astype(jnp.float32)
+        if pack.precision == "int8":
+            lv_all = (
+                lv_all * pack.leaf_scale[0][:, None]
+                + pack.leaf_zero[0][:, None]
+            )
+    parent = pack.cover[:, paths]  # (T, L, d)
+    ratio = jnp.where(
+        parent > 0,
+        pack.cover[:, child_heap] / jnp.maximum(parent, 1e-30),
+        0.0,
+    )
+    base = jnp.sum(lv_all * jnp.prod(ratio, axis=2))
+    return margin, prob, phis, base

@@ -40,6 +40,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from cobalt_smart_lender_ai_tpu.explain.treeshap import shap_values
 from cobalt_smart_lender_ai_tpu.models.gbdt import predict_margin
+from cobalt_smart_lender_ai_tpu.ops.score_pallas import (
+    ForestPack,
+    default_interpret,
+    fused_score,
+    fused_supported,
+    kernel_mode,
+    pack_forest,
+)
 from cobalt_smart_lender_ai_tpu.parallel.compat import shard_map
 from cobalt_smart_lender_ai_tpu.telemetry.programs import (
     default_program_registry,
@@ -82,6 +90,40 @@ def _forest_fingerprint(forest: Any) -> tuple:
     return (treedef, tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
 
 
+def _as_pack(forest: Any, n_features: int) -> ForestPack:
+    """Coerce a raw `Forest` into the fused kernel's `ForestPack` (f32
+    passthrough — no quantization implied). Callers that want bf16/int8
+    pack at artifact-publish time and hand the pack in directly, so the
+    quantization gate runs once per reload, not once per bucket compile."""
+    if isinstance(forest, ForestPack):
+        return forest
+    return pack_forest(forest, n_features, "f32")
+
+
+def _resolve_kernel(kernel: str | None) -> str:
+    k = kernel if kernel is not None else kernel_mode()
+    if k not in ("fused", "reference"):
+        raise ValueError(f"unknown kernel {k!r}; expected 'fused' or 'reference'")
+    return k
+
+
+def _route_fused(kernel: str | None, forest: Any, n_features: int) -> bool:
+    """Should this compile take the fused path?  An *explicit*
+    ``kernel="fused"`` always does (unsupported forests then fail loudly);
+    the mode default additionally requires the forest to fit the fused
+    kernel's envelope (`fused_supported`), so oversized forests quietly
+    keep the reference contractions at every call site."""
+    if _resolve_kernel(kernel) != "fused":
+        return False
+    if kernel == "fused":
+        return True
+    try:
+        n_trees = int(forest.feature.shape[0])
+        return fused_supported(n_trees, int(forest.depth), n_features)
+    except Exception:
+        return False
+
+
 def _exec_cache_get(key: tuple) -> Any | None:
     with _EXEC_LOCK:
         return _EXEC_CACHE.get(key)
@@ -102,6 +144,8 @@ def _program_for(
     device: Any = None,
     shards: int = 1,
     prefix: str = "serve",
+    out: str | None = None,
+    precision: str | None = None,
 ):
     """ProgramRegistry handle for a serving program — the observatory's
     hook into this cache. The name is the stable shape key an operator
@@ -109,13 +153,22 @@ def _program_for(
     (and ``device`` meta) so each replica's programs stay distinct rows.
     ``prefix`` separates workloads in the cost table: live serving compiles
     under ``serve.*``, the offline portfolio scorer under ``portfolio.*`` —
-    same executables (the exec cache ignores the prefix), distinct rows."""
+    same executables (the exec cache ignores the prefix), distinct rows.
+    Fused-kernel programs carry their output view (``out``: margin-only vs
+    full margin+SHAP) and, when quantized, the forest ``precision`` — one
+    fused executable is a different program row from another."""
     meta: dict[str, Any] = {
         "rows_per_dispatch": rows,
         "features": n_features,
         "shards": shards,
     }
     name = f"{prefix}.{kind}[rows={rows},features={n_features}"
+    if out is not None:
+        meta["out"] = out
+        name += f",out={out}"
+    if precision is not None and precision != "f32":
+        meta["precision"] = precision
+        name += f",prec={precision}"
     if shards > 1:
         name += f",shards={shards}"
     if device is not None:
@@ -194,15 +247,35 @@ class Partitioner(abc.ABC):
 
     @abc.abstractmethod
     def compile_margin(
-        self, forest: Any, n_features: int, rows: int
+        self, forest: Any, n_features: int, rows: int, *, kernel: str | None = None
     ) -> Callable[[np.ndarray], jax.Array]:
-        """AOT-compile ``(rows, F) -> (rows,)`` forest margins."""
+        """AOT-compile ``(rows, F) -> (rows,)`` forest margins.
+
+        ``kernel`` picks the implementation: ``"fused"`` routes through the
+        one-pass Pallas scoring kernel (margin view of `compile_fused`),
+        ``"reference"`` through the classic `predict_margin` contraction,
+        None defers to the process-wide `kernel_mode()` (fused by default,
+        ``COBALT_REFERENCE_KERNELS=1`` opts out)."""
 
     @abc.abstractmethod
     def compile_shap(
-        self, forest: Any, n_features: int, rows: int
+        self, forest: Any, n_features: int, rows: int, *, kernel: str | None = None
     ) -> Callable[[np.ndarray], tuple[jax.Array, jax.Array]]:
-        """AOT-compile ``(rows, F) -> ((rows, F) phis, scalar base)``."""
+        """AOT-compile ``(rows, F) -> ((rows, F) phis, scalar base)``.
+
+        Same ``kernel`` routing as `compile_margin`; the fused view shares
+        the full-output executable with `compile_fused(with_shap=True)`."""
+
+    @abc.abstractmethod
+    def compile_fused(
+        self, forest: Any, n_features: int, rows: int, *, with_shap: bool = True
+    ) -> Callable[[np.ndarray], tuple]:
+        """AOT-compile the fused Pallas scoring program: ONE dispatch over
+        the forest yielding ``(margin, prob)`` or, with SHAP,
+        ``(margin, prob, phis, base)``. Accepts a raw `Forest` (packed f32
+        on the fly) or a pre-built `ForestPack` (possibly bf16/int8); the
+        executable cache key includes the pack's precision and quantization
+        table hash so reloads that flip precision never alias."""
 
     @abc.abstractmethod
     def compile_rowwise(
@@ -271,7 +344,45 @@ class SingleDevicePartitioner(Partitioner):
             return contextlib.nullcontext()
         return jax.default_device(self._device)
 
-    def compile_margin(self, forest, n_features, rows):
+    def compile_fused(self, forest, n_features, rows, *, with_shap=True):
+        pack = _as_pack(forest, n_features)
+        key = (
+            "fused", with_shap, self._device, rows, n_features,
+            _forest_fingerprint(pack), pack.precision, pack.table_hash,
+        )
+        prog = _program_for(
+            "fused",
+            rows=rows,
+            n_features=n_features,
+            device=self._device,
+            prefix=self._kind_prefix,
+            out="full" if with_shap else "margin",
+            precision=pack.precision,
+        )
+        compiled = _exec_cache_get(key)
+        if compiled is None:
+            t0 = time.perf_counter()
+            with self._ctx():
+                compiled = (
+                    fused_score.lower(
+                        pack,
+                        jax.ShapeDtypeStruct((rows, n_features), jnp.float32),
+                        n_features=n_features,
+                        with_shap=with_shap,
+                        interpret=default_interpret(),
+                    )
+                    .compile()
+                )
+            prog.record_compile(time.perf_counter() - t0, compiled)
+            compiled = _exec_cache_put(key, compiled)
+        else:
+            prog.ensure_cost(compiled)
+        return prog.wrap(lambda X: compiled(pack, X))
+
+    def compile_margin(self, forest, n_features, rows, *, kernel=None):
+        if _route_fused(kernel, forest, n_features):
+            fn = self.compile_fused(forest, n_features, rows, with_shap=False)
+            return lambda X: fn(X)[0]
         # The forest is staged as a program *argument*, not a closed-over
         # constant: constant-embedding re-lowers every tree tensor into the
         # module (one device round-trip per array, all under the GIL), which
@@ -307,7 +418,10 @@ class SingleDevicePartitioner(Partitioner):
             prog.ensure_cost(compiled)
         return prog.wrap(lambda X: compiled(forest, X))
 
-    def compile_shap(self, forest, n_features, rows):
+    def compile_shap(self, forest, n_features, rows, *, kernel=None):
+        if _route_fused(kernel, forest, n_features):
+            fn = self.compile_fused(forest, n_features, rows, with_shap=True)
+            return lambda X: fn(X)[2:4]
         key = (
             "shap", self._device, rows, n_features,
             _forest_fingerprint(forest),
@@ -418,7 +532,69 @@ class MeshPartitioner(Partitioner):
     def _mesh_key(self) -> tuple:
         return (tuple(self._mesh.devices.flat), self._dp_axis, self._rules)
 
-    def compile_margin(self, forest, n_features, rows):
+    def compile_fused(self, forest, n_features, rows, *, with_shap=True):
+        self._check_rows(rows)
+        pack = _as_pack(forest, n_features)
+        key = (
+            "mesh_fused", with_shap, self._mesh_key(), rows, n_features,
+            _forest_fingerprint(pack), pack.precision, pack.table_hash,
+        )
+        prog = _program_for(
+            "mesh_fused",
+            rows=rows,
+            n_features=n_features,
+            shards=self.n_shards,
+            prefix=self._kind_prefix,
+            out="full" if with_shap else "margin",
+            precision=pack.precision,
+        )
+        compiled = _exec_cache_get(key)
+        if compiled is None:
+            # Like the reference programs: pack replicated (P() rule as a
+            # pytree prefix), rows sharded over dp. margin/prob come back
+            # row-sharded; phis row-sharded x replicated features; base is a
+            # forest-only scalar every shard computes identically.
+            out_specs = (
+                (P(self._dp_axis), P(self._dp_axis), P(self._dp_axis, None), P())
+                if with_shap
+                else (P(self._dp_axis), P(self._dp_axis))
+            )
+
+            @partial(
+                shard_map,
+                mesh=self._mesh,
+                in_specs=(self._forest_spec, self._rows_spec),
+                out_specs=out_specs,
+                check_vma=False,
+            )
+            def _fused(pack_l, X_l):
+                return fused_score(
+                    pack_l,
+                    X_l,
+                    n_features=n_features,
+                    with_shap=with_shap,
+                    interpret=default_interpret(),
+                )
+
+            t0 = time.perf_counter()
+            compiled = (
+                jax.jit(_fused)
+                .lower(
+                    pack,
+                    jax.ShapeDtypeStruct((rows, n_features), jnp.float32),
+                )
+                .compile()
+            )
+            prog.record_compile(time.perf_counter() - t0, compiled)
+            compiled = _exec_cache_put(key, compiled)
+        else:
+            prog.ensure_cost(compiled)
+        return prog.wrap(lambda X: compiled(pack, X))
+
+    def compile_margin(self, forest, n_features, rows, *, kernel=None):
+        if _route_fused(kernel, forest, n_features):
+            fn = self.compile_fused(forest, n_features, rows, with_shap=False)
+            return lambda X: fn(X)[0]
         self._check_rows(rows)
         key = (
             "mesh_margin", self._mesh_key(), rows, n_features,
@@ -459,7 +635,10 @@ class MeshPartitioner(Partitioner):
             prog.ensure_cost(compiled)
         return prog.wrap(lambda X: compiled(forest, X))
 
-    def compile_shap(self, forest, n_features, rows):
+    def compile_shap(self, forest, n_features, rows, *, kernel=None):
+        if _route_fused(kernel, forest, n_features):
+            fn = self.compile_fused(forest, n_features, rows, with_shap=True)
+            return lambda X: fn(X)[2:4]
         self._check_rows(rows)
         key = (
             "mesh_shap", self._mesh_key(), rows, n_features,

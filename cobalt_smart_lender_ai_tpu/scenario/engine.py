@@ -268,7 +268,12 @@ class PortfolioScorer:
     def _fingerprint(self, portfolio_md5: str, n_rows: int, grid_json: dict) -> str:
         # The shard count is intentionally absent: sharding the row axis
         # cannot change any row's bits (partitioner contract), so a resume
-        # on a different mesh must reuse the same checkpoint.
+        # on a different mesh must reuse the same checkpoint. The kernel
+        # mode IS present: fused f32 margins are bit-identical to the
+        # reference, but SHAP chunk bytes may differ at float tolerance, so
+        # a resume never mixes chunks from two kernel implementations.
+        from cobalt_smart_lender_ai_tpu.ops.score_pallas import kernel_mode
+
         return config_fingerprint(
             {
                 "model_md5": self._model_md5(),
@@ -279,6 +284,7 @@ class PortfolioScorer:
                 "grid": grid_json,
                 "pd_bands": list(self.pd_bands),
                 "shap": self.compute_shap,
+                "kernel": kernel_mode(),
             }
         )
 

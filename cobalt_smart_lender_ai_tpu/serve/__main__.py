@@ -117,6 +117,21 @@ def main() -> None:
         help="fraction of scoring traffic shadow-scored against the canary",
     )
     parser.add_argument(
+        "--reference-kernels",
+        action="store_true",
+        help="score on the classic margin + SHAP program pair instead of "
+        "the fused one-dispatch Pallas kernel (README 'Scoring kernels & "
+        "precision'); same as COBALT_REFERENCE_KERNELS=1",
+    )
+    parser.add_argument(
+        "--forest-precision",
+        choices=("f32", "bf16", "int8"),
+        default=ServeConfig.forest_precision,
+        help="packed forest representation for the fused kernel: f32 "
+        "(exact, default), bf16, or int8 (affine scale/zero-point tables "
+        "built at model load, gated by the committed tolerance contract)",
+    )
+    parser.add_argument(
         "--serve-impl",
         choices=("auto", "asyncio", "fastapi"),
         default="auto",
@@ -155,7 +170,15 @@ def main() -> None:
         canary_enabled=args.canary,
         model_name=args.model_name,
         canary_sample_rate=args.canary_sample_rate,
+        fused_kernels=not args.reference_kernels,
+        forest_precision=args.forest_precision,
     )
+    if args.reference_kernels:
+        # Also flip the process-wide default so every compile path —
+        # including partitioners built outside a ServeConfig — agrees.
+        from cobalt_smart_lender_ai_tpu.ops.score_pallas import set_kernel_mode
+
+        set_kernel_mode("reference")
     # ReplicaSet.from_store returns a plain ScorerService at replicas<=1;
     # both present the identical adapter surface.
     from cobalt_smart_lender_ai_tpu.serve.replicas import ReplicaSet
@@ -168,6 +191,9 @@ def main() -> None:
         print(f"[INFO] {len(service.replicas)} replicas behind the "
               f"least-loaded router; devices: "
               f"{ready_payload['replica_devices']}")
+    print(f"[INFO] scoring kernel: "
+          f"{'reference' if args.reference_kernels else 'fused'} "
+          f"(forest precision {cfg.forest_precision})")
     if cfg.bulk_shards not in (0, 1):
         print(f"[INFO] bulk scoring sharded over the dp mesh "
               f"(bulk_shards={cfg.bulk_shards})")

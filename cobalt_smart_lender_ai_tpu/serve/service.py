@@ -68,6 +68,11 @@ from cobalt_smart_lender_ai_tpu.data import schema
 from cobalt_smart_lender_ai_tpu.data.device_pipeline import transform_raw_rows
 from cobalt_smart_lender_ai_tpu.io import GBDTArtifact, ObjectStore
 from cobalt_smart_lender_ai_tpu.models.gbdt import gain_importances
+from cobalt_smart_lender_ai_tpu.ops.score_pallas import (
+    PRECISIONS,
+    kernel_mode,
+    pack_forest,
+)
 from cobalt_smart_lender_ai_tpu.parallel.partitioner import (
     SingleDevicePartitioner,
     make_partitioner,
@@ -223,6 +228,53 @@ class _CompiledModel:
         self._feature_index = {n: i for i, n in enumerate(self.feature_names)}
         forest = artifact.forest
         self.forest = forest
+        # Scoring kernel + packed forest (ops/score_pallas.py, README
+        # "Scoring kernels & precision"). The pack — including the bf16/int8
+        # scale/zero-point tables — is built ONCE here, at publish time, so
+        # the quantization tolerance gate (`pack_forest(check=True)` against
+        # PRECISION_TOLERANCES) runs before this bundle can be published;
+        # a forest that fails its precision contract never serves.
+        # `kernel`/`precision`/`quant_table_hash` feed /readyz, the
+        # model-info metric labels, and the score-cache salt below.
+        self.precision = config.forest_precision
+        if self.precision not in PRECISIONS:
+            raise ValueError(
+                f"forest_precision={self.precision!r}: expected one of "
+                f"{PRECISIONS}"
+            )
+        self.kernel = (
+            "fused"
+            if config.fused_kernels and kernel_mode() == "fused"
+            else "reference"
+        )
+        if self.kernel != "fused" and self.precision != "f32":
+            raise ValueError(
+                f"forest_precision={self.precision!r} requires the fused "
+                "kernel; the reference contractions only run the exact f32 "
+                "forest"
+            )
+        self.pack = (
+            pack_forest(forest, self.n_features, self.precision)
+            if self.kernel == "fused"
+            else None
+        )
+        self.quant_table_hash = (
+            self.pack.table_hash if self.pack is not None else "f32"
+        )
+        # Score-cache salt: single-row cache keys are prefixed with
+        # (kernel, precision, quantization-table hash), so an f32 response
+        # can never alias an int8 one across a hot reload that flips
+        # precision — the cached bytes belong to THIS scoring identity.
+        self.cache_salt = (
+            f"{self.kernel}:{self.precision}:{self.quant_table_hash}|".encode()
+        )
+        # The micro-batcher's one-dispatch path: margin + sigmoid + SHAP
+        # from a single fused program. Cleared when a test injects its own
+        # SHAP program (the injected program must actually be exercised) or
+        # when a fused bucket compile degrades.
+        self.use_fused_dispatch = self.kernel == "fused"
+        self.bucket_kernels: dict[int, str] = {}
+        self.fused_fns: dict[int, Any] = {}
         # Where the programs run (README "Scaling out"): `local` compiles
         # the per-request and single-device programs — pinned to ``device``
         # when the replica engine places each shared-nothing replica on its
@@ -232,7 +284,9 @@ class _CompiledModel:
         self.bulk_part = make_partitioner(config.bulk_shards, device=device)
         # Pre-compile both device programs at startup (the reference builds
         # its TreeExplainer in the lifespan hook for the same reason).
-        self.margin_fn = self.local.compile_margin(forest, self.n_features, 1)
+        self.margin_fn, self.bucket_kernels[1] = self._margin_program(
+            self.local, 1
+        )
         # SHAP is the one *optional* device program: probabilities are the
         # service's contract, attributions are an enrichment. With
         # `reliability.degrade_shap` (default), a SHAP compile failure leaves
@@ -242,7 +296,7 @@ class _CompiledModel:
         self.shap_fn = None
         self.shap_error: str | None = None
         try:
-            self.shap_fn = self.local.compile_shap(forest, self.n_features, 1)
+            self.shap_fn, _ = self._shap_program(self.local, 1)
         except Exception as exc:
             if not config.reliability.degrade_shap:
                 raise
@@ -284,6 +338,10 @@ class _CompiledModel:
             for b in buckets:
                 self.margin_for_bucket(b)
                 self.shap_for_bucket(b)
+                # The one-dispatch fused program shares its executable with
+                # the SHAP view above — this wrap is a cache hit, not a
+                # third compile.
+                self.fused_for_bucket(b)
         total_gain, _ = gain_importances(forest, self.n_features)
         self.gain = np.asarray(total_gain)
 
@@ -291,6 +349,54 @@ class _CompiledModel:
         """Smallest power-of-two >= n, capped at max_batch_rows (larger
         requests are chunked)."""
         return min(1 << max(0, n - 1).bit_length(), self.config.max_batch_rows)
+
+    def _margin_program(self, part, rows):
+        """Kernel-routed margin compile -> ``(fn, kernel_used)``. The fused
+        path hands the partitioner the pre-built pack (its precision +
+        table hash key the executable cache); an f32 fused compile failure
+        falls back to the bit-identical reference contraction instead of
+        failing the model build — quantized precisions have no reference
+        equivalent, so their failures stay loud."""
+        if self.kernel == "fused":
+            try:
+                fn = part.compile_margin(
+                    self.pack, self.n_features, rows, kernel="fused"
+                )
+                return fn, "fused"
+            except Exception as exc:
+                if self.precision != "f32":
+                    raise
+                _LOG.warning(
+                    "fused_margin_fallback",
+                    rows=rows,
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+        fn = part.compile_margin(
+            self.forest, self.n_features, rows, kernel="reference"
+        )
+        return fn, "reference"
+
+    def _shap_program(self, part, rows):
+        """Kernel-routed SHAP compile -> ``(fn, kernel_used)``; same
+        fallback contract as `_margin_program`."""
+        if self.kernel == "fused":
+            try:
+                fn = part.compile_shap(
+                    self.pack, self.n_features, rows, kernel="fused"
+                )
+                return fn, "fused"
+            except Exception as exc:
+                if self.precision != "f32":
+                    raise
+                _LOG.warning(
+                    "fused_shap_fallback",
+                    rows=rows,
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+        fn = part.compile_shap(
+            self.forest, self.n_features, rows, kernel="reference"
+        )
+        return fn, "reference"
 
     def margin_for_bucket(self, bucket: int):
         fn = self.bucket_fns.get(bucket)
@@ -301,10 +407,40 @@ class _CompiledModel:
             with self._bucket_lock:
                 fn = self.bucket_fns.get(bucket)
                 if fn is None:
-                    fn = self.local.compile_margin(
-                        self.forest, self.n_features, bucket
-                    )
+                    fn, used = self._margin_program(self.local, bucket)
+                    self.bucket_kernels[bucket] = used
                     self.bucket_fns[bucket] = fn
+        return fn
+
+    def fused_for_bucket(self, bucket: int):
+        """Full-output fused program — ONE dispatch returning
+        ``(margin, prob, phis, base)`` — for the micro-batcher's coalesced
+        path. ``None`` when this model scores on the reference kernel, SHAP
+        is degraded, or a test injected its own SHAP program
+        (`use_fused_dispatch` cleared); callers then fall back to the
+        margin + SHAP program pair. Shares its executable with the fused
+        `shap_for_bucket` view, so a warm SHAP bucket makes this a cache
+        hit."""
+        if not self.use_fused_dispatch or self.shap_fn is None:
+            return None
+        fn = self.fused_fns.get(bucket)
+        if fn is None:
+            with self._bucket_lock:
+                fn = self.fused_fns.get(bucket)
+                if fn is None:
+                    try:
+                        fn = self.local.compile_fused(
+                            self.pack, self.n_features, bucket, with_shap=True
+                        )
+                    except Exception as exc:
+                        # Same degradation contract as `shap_for_bucket`:
+                        # probabilities keep serving on the program pair.
+                        if not self.config.reliability.degrade_shap:
+                            raise
+                        self.shap_error = f"{type(exc).__name__}: {exc}"
+                        self.use_fused_dispatch = False
+                        return None
+                    self.fused_fns[bucket] = fn
         return fn
 
     def shap_for_bucket(self, bucket: int):
@@ -323,9 +459,7 @@ class _CompiledModel:
                 fn = self.shap_bucket_fns.get(bucket)
                 if fn is None:
                     try:
-                        fn = self.local.compile_shap(
-                            self.forest, self.n_features, bucket
-                        )
+                        fn, _ = self._shap_program(self.local, bucket)
                     except Exception as exc:
                         if not self.config.reliability.degrade_shap:
                             raise
@@ -364,8 +498,8 @@ class _CompiledModel:
             with self._bucket_lock:
                 fn = self.bulk_fns.get(bucket)
                 if fn is None:
-                    fn = part.compile_margin(
-                        self.forest, self.n_features, bucket * part.n_shards
+                    fn, _ = self._margin_program(
+                        part, bucket * part.n_shards
                     )
                     self.bulk_fns[bucket] = fn
         return fn
@@ -385,8 +519,8 @@ class _CompiledModel:
                 fn = self.bulk_shap_fns.get(bucket)
                 if fn is None:
                     try:
-                        fn = part.compile_shap(
-                            self.forest, self.n_features, bucket * part.n_shards
+                        fn, _ = self._shap_program(
+                            part, bucket * part.n_shards
                         )
                     except Exception as exc:
                         if not self.config.reliability.degrade_shap:
@@ -899,6 +1033,20 @@ class MicroBatcher:
             buf = scratch[:bucket]
             buf[:n] = model.rows_array([row for row, _, _, _, _ in live])
             buf[n:] = 0.0
+            phis = base = None
+            shap_error: str | None = None
+            bo = self._service.brownout
+            shed_shap = (
+                bo is not None
+                and bo.level >= 2
+                and self._service.config.reliability.degrade_shap
+            )
+            # Fused fast path (ops/score_pallas.py): margin + sigmoid +
+            # SHAP in ONE device dispatch, leaving the serve.shap phase
+            # below nothing to do. Brownout rung 2 skips the fused program
+            # — it would compute exactly the phis being shed — and scores
+            # margins on the classic program instead.
+            fused_fn = None if shed_shap else model.fused_for_bucket(bucket)
             # Child spans time the two device phases separately — their
             # durations ride each request's future back to the submitting
             # thread, where they land in the phase histogram and flight
@@ -911,37 +1059,46 @@ class MicroBatcher:
                 # batch on its own device, so a pinned replica's batcher
                 # never routes rows through the process default device.
                 xb = buf
-                probs = np.asarray(
-                    jax.nn.sigmoid(model.margin_for_bucket(bucket)(xb))
-                )[:n]
-            phis = base = None
-            shap_error: str | None = None
-            bo = self._service.brownout
+                if fused_fn is not None:
+                    try:
+                        _, probs_all, phis_all, base_v = fused_fn(xb)
+                        probs = np.asarray(probs_all)[:n]
+                        phis = np.asarray(phis_all)[:n]
+                        base = float(base_v)
+                    except Exception as exc:
+                        shap_error = f"{type(exc).__name__}: {exc}"
+                        fused_fn = None
+                if fused_fn is None:
+                    probs = np.asarray(
+                        jax.nn.sigmoid(model.margin_for_bucket(bucket)(xb))
+                    )[:n]
             with default_tracer().span(
                 "serve.shap", rows=n, bucket=bucket
             ) as s_sp:
-                shap_fn = model.shap_for_bucket(bucket)
-                if (
-                    bo is not None
-                    and bo.level >= 2
-                    and self._service.config.reliability.degrade_shap
-                ):
+                if phis is not None:
+                    pass  # the fused dispatch already produced attributions
+                elif shed_shap:
                     # Brownout rung 2: shed the SHAP phase (the dominant
                     # per-batch cost) but keep scoring. The sentinel is
                     # load-shedding, not a compile failure — `_finish_batched`
                     # must never persist it into `model.shap_error`.
                     shap_error = BROWNOUT_SHAP_SHED
-                elif shap_fn is None:
-                    shap_error = (
-                        model.shap_error or "SHAP program unavailable"
-                    )
                 else:
-                    try:
-                        phis_all, base_v = shap_fn(xb)
-                        phis = np.asarray(phis_all)[:n]
-                        base = float(base_v)
-                    except Exception as exc:
-                        shap_error = f"{type(exc).__name__}: {exc}"
+                    shap_fn = model.shap_for_bucket(bucket)
+                    if shap_fn is None:
+                        shap_error = (
+                            shap_error
+                            or model.shap_error
+                            or "SHAP program unavailable"
+                        )
+                    else:
+                        try:
+                            phis_all, base_v = shap_fn(xb)
+                            phis = np.asarray(phis_all)[:n]
+                            base = float(base_v)
+                            shap_error = None  # classic pair recovered
+                        except Exception as exc:
+                            shap_error = f"{type(exc).__name__}: {exc}"
         dispatch_s = d_sp.duration_s or 0.0
         shap_s = s_sp.duration_s or 0.0
         self._m_batches.inc()
@@ -1214,9 +1371,21 @@ class ScorerService:
         self._m_model_info = reg.gauge(
             "cobalt_model_info",
             "1 for the model version currently serving (identity labels)",
-            ("version", "channel", "provenance_md5"),
+            # precision/kernel appended LAST: dashboards join on the
+            # leading identity labels and keep working unchanged.
+            ("version", "channel", "provenance_md5", "precision", "kernel"),
         )
-        self._model_info_labels = ("unversioned", "direct", "none")
+        # Derived from config (the model bundle is built after metrics):
+        # same resolution `_CompiledModel` applies.
+        self._model_info_labels = (
+            "unversioned",
+            "direct",
+            "none",
+            self.config.forest_precision,
+            "fused"
+            if self.config.fused_kernels and kernel_mode() == "fused"
+            else "reference",
+        )
         self._m_model_info.labels(*self._model_info_labels).set(1.0)
         # Performance observatory: the process program cost table
         # (telemetry.programs) and device/host memory gauges ride this
@@ -1324,6 +1493,10 @@ class ScorerService:
         self._model.shap_fn = fn
         # keep the bucket cache coherent: bucket 1 IS the (1, F) program
         self._model.shap_bucket_fns = {} if fn is None else {1: fn}
+        # An injected program must actually be exercised: the fused
+        # one-dispatch path computes its own phis and would bypass it.
+        self._model.use_fused_dispatch = False
+        self._model.fused_fns = {}
 
     @property
     def _shap_error(self) -> str | None:
@@ -1525,7 +1698,13 @@ class ScorerService:
             "channel": channel,
             "provenance_md5": provenance_md5,
         }
-        new_labels = (version, channel, provenance_md5 or "none")
+        new_labels = (
+            version,
+            channel,
+            provenance_md5 or "none",
+            self._model.precision,
+            self._model.kernel,
+        )
         self._m_model_info.labels(*self._model_info_labels).set(0.0)
         self._m_model_info.labels(*new_labels).set(1.0)
         self._model_info_labels = new_labels
@@ -1701,6 +1880,23 @@ class ScorerService:
             "compiled_shap_buckets": list(self.compiled_shap_buckets),
             "shap": "ok" if model.shap_fn is not None else "degraded",
             "degraded": model.shap_fn is None,
+            # Active scoring kernel + forest precision (ops/score_pallas.py):
+            # which implementation each warmed bucket compiled to (an f32
+            # fused compile failure falls back per-bucket to the reference
+            # contraction), whether the micro-batcher runs the one-dispatch
+            # fused program, and the quantization-table hash that salts the
+            # score cache. tests/test_score_kernel.py asserts this block.
+            "kernels": {
+                "active": model.kernel,
+                "precision": model.precision,
+                "quant_table": model.quant_table_hash,
+                "fused_dispatch": bool(
+                    model.use_fused_dispatch and model.shap_fn is not None
+                ),
+                "buckets": {
+                    str(b): k for b, k in sorted(model.bucket_kernels.items())
+                },
+            },
             "breaker": self.store_breaker.state,
             "admission": self.admission.stats(),
             # Mesh/shard shape of the bulk path plus the sharded programs
@@ -1775,7 +1971,11 @@ class ScorerService:
             # spelling. Only full (non-degraded) responses are cached, so a
             # hit always carries attributions.
             cache_model = model = self._model
-            cache_key = model.rows_array([row]).tobytes()
+            # The salt pins the entry to this model's scoring identity
+            # (kernel, precision, quantization table): a hot reload that
+            # flips precision changes the salt, so stale f32/int8 bytes
+            # can never alias each other.
+            cache_key = model.cache_salt + model.rows_array([row]).tobytes()
             cached = self._score_cache_get(cache_key)
             if cached is not None:
                 self._m_cache_hits.inc()

@@ -191,6 +191,28 @@ def _from_pipeline_ingest(record: dict, metrics: dict) -> None:
                 )
 
 
+def _from_bench_kernel(record: dict, metrics: dict) -> None:
+    """BENCH_KERNEL / tools/bench_kernels.py: fused vs reference scoring
+    dispatch wall at serving bucket sizes, per forest precision. Bucket and
+    precision join the series name so every (impl, precision, bucket) cell
+    gates against its own baseline — the ``dispatch_seconds`` leaf
+    auto-gates at 1.25x lower-is-better by name shape."""
+    for prec, buckets in (record.get("results") or {}).items():
+        if not isinstance(buckets, dict):
+            continue
+        for bucket, row in buckets.items():
+            if not isinstance(row, dict):
+                continue
+            for impl in ("fused", "reference"):
+                cell = row.get(impl)
+                if isinstance(cell, dict):
+                    _put(
+                        metrics,
+                        f"kernel.{impl}.{prec}.b{bucket}.dispatch_seconds",
+                        cell.get("dispatch_seconds"),
+                    )
+
+
 def _from_search(record: dict, metrics: dict) -> None:
     """BENCH_SEARCH / BENCH_SEARCH_WARM / tools/bench_search.py output."""
     compile_block = record.get("compile") or {}
@@ -262,6 +284,8 @@ def extract_metrics(record: dict) -> dict[str, float]:
         _from_search(record, metrics)
     elif bench == "pipeline_ingest":
         _from_pipeline_ingest(record, metrics)
+    elif bench == "score_kernels":
+        _from_bench_kernel(record, metrics)
     elif "schema" in record and "kind" in record:
         _from_ledger(record, metrics)
     elif "metric" in record and "value" in record:

@@ -114,7 +114,10 @@ def test_mesh_partitioner_programs_captured(fresh_programs):
     assert np.asarray(out).shape == (128,)
 
     table = fresh_programs.table()
-    row = next(r for r in table if r["name"].startswith("serve.mesh_margin"))
+    # The default kernel mode routes mesh margins through the fused
+    # one-pass program (margin view); the registry row carries the same
+    # shard/compile/dispatch accounting either way.
+    row = next(r for r in table if r["name"].startswith("serve.mesh_fused"))
     assert row["shards"] == 8
     assert row["compiles"] == 1 and row["compile_seconds"] > 0
     assert row["dispatches"] == 1 and row["dispatch_seconds"] > 0
@@ -202,7 +205,7 @@ def test_debug_programs_and_metrics_live_on_serving(observatory_server):
     assert dispatched and all(
         r["dispatch_seconds"] > 0 for r in dispatched
     )
-    assert any(name.startswith("serve.margin") for name in rows)
+    assert any(name.startswith("serve.fused") for name in rows)
     assert body["totals"]["dispatch_seconds"] > 0
 
     # The SAME table rides the service's Prometheus scrape.

@@ -516,10 +516,18 @@ def degraded_service(serving_artifact, monkeypatch):
     def broken_shap(*a, **k):
         raise RuntimeError("SHAP compile forced to fail")
 
+    class _BrokenFused:
+        def lower(self, *a, **k):
+            raise RuntimeError("fused lowering forced to fail")
+
     # The SHAP program is compiled by the partitioner (not the service), and
     # structure-identical forests share cached executables — swap in an empty
-    # cache so the forced compile failure actually fires.
+    # cache so the forced compile failure actually fires. The fused kernel
+    # computes SHAP itself (it never calls shap_values), so break its
+    # lowering too: this fixture now exercises the full
+    # fused -> reference -> degrade fallback chain.
     monkeypatch.setattr(partitioner_mod, "shap_values", broken_shap)
+    monkeypatch.setattr(partitioner_mod, "fused_score", _BrokenFused())
     monkeypatch.setattr(partitioner_mod, "_EXEC_CACHE", {})
     store, _ = serving_artifact
     return service_mod.ScorerService.from_store(store, _fast_cfg())
@@ -587,7 +595,12 @@ def test_degrade_disabled_raises(serving_artifact, monkeypatch):
     def broken_shap(*a, **k):
         raise RuntimeError("SHAP compile forced to fail")
 
+    class _BrokenFused:
+        def lower(self, *a, **k):
+            raise RuntimeError("fused lowering forced to fail")
+
     monkeypatch.setattr(partitioner_mod, "shap_values", broken_shap)
+    monkeypatch.setattr(partitioner_mod, "fused_score", _BrokenFused())
     monkeypatch.setattr(partitioner_mod, "_EXEC_CACHE", {})
     store, _ = serving_artifact
     cfg = ServeConfig(

@@ -1,0 +1,306 @@
+"""Fused scoring kernel (ops/score_pallas.py): parity, quantization, mesh.
+
+The contract under test, layer by layer:
+
+- **f32 bit-parity** (interpret mode, the CPU-CI lowering): the fused
+  one-dispatch program's margins are bit-identical to `predict_margin`,
+  its probabilities exactly `sigmoid(margin)`, and its SHAP phis match
+  `shap_values` to float tolerance with additivity intact. The kernel
+  accumulates leaf values in the same per-tree scan order as the
+  reference, and the one-hot leaf mask adds exact zeros elsewhere — so
+  equality is exact, not approximate.
+- **Quantized packs** (bf16 / int8 thresholds + leaf values with affine
+  scale/zero-point tables built at pack time): margins drift within the
+  committed `PRECISION_TOLERANCES` contract and ranking survives — AUC on
+  a trained mini forest stays within a hair of f32.
+- **Mesh == single**: the shard_map'd fused program on a forced 4-device
+  mesh returns bit-identical margins to the single-device program
+  (tests/test_partitioner.py's anchor, now for the fused path).
+- **Serving integration**: `serve.fused[...]` programs appear in
+  ``GET /debug/programs``, /readyz reports the active kernel + precision
+  per bucket, and the score cache never aliases across precisions.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cobalt_smart_lender_ai_tpu.explain.treeshap import shap_values
+from cobalt_smart_lender_ai_tpu.models.gbdt import (
+    GBDTClassifier,
+    predict_margin,
+)
+from cobalt_smart_lender_ai_tpu.ops.metrics import roc_auc
+from cobalt_smart_lender_ai_tpu.ops.score_pallas import (
+    PRECISION_TOLERANCES,
+    fused_score,
+    kernel_mode,
+    pack_forest,
+    probe_rows,
+    quantization_report,
+    set_kernel_mode,
+)
+from cobalt_smart_lender_ai_tpu.parallel.partitioner import (
+    SingleDevicePartitioner,
+    make_partitioner,
+)
+
+# --- fixtures -----------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mini_forest():
+    """Trained mini forest + the data that trained it (margins are real
+    learned values, not synthetic tensors — threshold quantization error
+    depends on learned split geometry)."""
+    rng = np.random.default_rng(5)
+    F = 12
+    X = rng.normal(size=(1024, F)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] - 0.25 * X[:, 2] > 0).astype(np.int32)
+    model = GBDTClassifier(n_estimators=20, max_depth=3, n_bins=64)
+    model.fit(X, y)
+    return model.forest, X, y, F
+
+
+# --- f32 bit-parity (interpret mode) ------------------------------------------
+
+
+@pytest.mark.parametrize("rows", [1, 7, 64])
+def test_fused_f32_margins_bit_identical(mini_forest, rows):
+    forest, X, _, F = mini_forest
+    xb = X[:rows]
+    # NaNs must follow the learned missing direction, same as the reference.
+    xb = np.array(xb)
+    xb[0, 3] = np.nan
+    pack = pack_forest(forest, F, "f32")
+    margin, prob, phis, base = fused_score(pack, jnp.asarray(xb), n_features=F)
+    ref = predict_margin(forest, jnp.asarray(xb))
+    assert bool(jnp.all(margin == ref))  # bit-identical, not approx
+    assert bool(jnp.all(prob == jax.nn.sigmoid(ref)))  # sigmoid-matched
+    ref_phis, ref_base = shap_values(forest, jnp.asarray(xb), n_features=F)
+    np.testing.assert_allclose(phis, ref_phis, atol=1e-5)
+    assert float(abs(base - ref_base)) < 1e-5
+    # Additivity: base + sum(phis) == margin.
+    np.testing.assert_allclose(
+        base + np.asarray(phis).sum(axis=1), np.asarray(margin), atol=1e-4
+    )
+
+
+def test_fused_margin_only_view(mini_forest):
+    forest, X, _, F = mini_forest
+    pack = pack_forest(forest, F, "f32")
+    margin, prob = fused_score(
+        pack, jnp.asarray(X[:16]), n_features=F, with_shap=False
+    )
+    ref = predict_margin(forest, jnp.asarray(X[:16]))
+    assert bool(jnp.all(margin == ref))
+    assert bool(jnp.all(prob == jax.nn.sigmoid(ref)))
+
+
+def test_kernel_mode_default_and_env(monkeypatch):
+    assert kernel_mode() == "fused"  # default-on
+    monkeypatch.setenv("COBALT_REFERENCE_KERNELS", "1")
+    assert kernel_mode() == "reference"
+    monkeypatch.delenv("COBALT_REFERENCE_KERNELS")
+    set_kernel_mode("reference")
+    try:
+        assert kernel_mode() == "reference"
+    finally:
+        set_kernel_mode(None)
+    assert kernel_mode() == "fused"
+
+
+# --- quantized packs ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("precision", ["bf16", "int8"])
+def test_quantized_roundtrip_within_committed_tolerance(mini_forest, precision):
+    forest, X, y, F = mini_forest
+    # pack_forest(check=True) already gates on the committed contract over
+    # the deterministic probe rows; assert the report the gate consumed.
+    pack = pack_forest(forest, F, precision)
+    report = quantization_report(forest, pack, F)
+    assert report["within_tolerance"], report
+    tol = PRECISION_TOLERANCES[precision]
+    assert report["mean_abs_margin_delta"] <= tol["mean_abs_margin_delta"]
+    assert report["max_abs_margin_delta"] <= tol["max_abs_margin_delta"]
+    assert report["mean_abs_prob_delta"] <= tol["mean_abs_prob_delta"]
+
+    # Max-abs-delta on real (trained-distribution) rows, not just probes.
+    xb = jnp.asarray(X[:256])
+    q_margin = fused_score(pack, xb, n_features=F, with_shap=False)[0]
+    ref = predict_margin(forest, xb)
+    assert float(jnp.max(jnp.abs(q_margin - ref))) <= tol["max_abs_margin_delta"]
+
+    # AUC preservation: quantization may nudge individual margins but must
+    # not degrade ranking on the training distribution.
+    auc_ref = float(roc_auc(jnp.asarray(y[:256]), ref))
+    auc_q = float(roc_auc(jnp.asarray(y[:256]), q_margin))
+    assert auc_q >= auc_ref - 0.01, (auc_ref, auc_q)
+
+
+def test_quantized_packs_have_distinct_table_hashes(mini_forest):
+    forest, _, _, F = mini_forest
+    hashes = {
+        p: pack_forest(forest, F, p).table_hash for p in ("f32", "bf16", "int8")
+    }
+    assert len(set(hashes.values())) == 3, hashes
+
+
+def test_probe_rows_are_deterministic(mini_forest):
+    forest, _, _, F = mini_forest
+    a = probe_rows(forest, F)
+    b = probe_rows(forest, F)
+    np.testing.assert_array_equal(a, b)
+
+
+# --- mesh == single -----------------------------------------------------------
+
+
+def test_forced_mesh_fused_equals_single(mini_forest):
+    forest, X, _, F = mini_forest
+    # conftest forces 8 virtual devices; the CI kernel-smoke job forces 4.
+    assert jax.device_count() >= 4
+    single = SingleDevicePartitioner()
+    mesh = make_partitioner(4)
+    assert mesh.n_shards == 4
+    rows = 128
+    xb = X[:rows]
+    ref = single.compile_margin(forest, F, rows, kernel="reference")(xb)
+    mesh_margin = mesh.compile_margin(forest, F, rows)(xb)  # default = fused
+    assert bool(jnp.all(mesh_margin == ref))
+    mesh_phis, mesh_base = mesh.compile_shap(forest, F, rows)(xb)
+    ref_phis, ref_base = single.compile_shap(forest, F, rows, kernel="reference")(xb)
+    np.testing.assert_allclose(mesh_phis, ref_phis, atol=1e-5)
+    assert float(abs(mesh_base - ref_base)) < 1e-5
+
+
+def test_fused_programs_share_executable_cache(mini_forest):
+    forest, _, _, F = mini_forest
+    part = SingleDevicePartitioner()
+    from cobalt_smart_lender_ai_tpu.parallel import partitioner as pmod
+
+    pack = pack_forest(forest, F, "f32")
+    part.compile_fused(pack, F, 32)
+    before = len(pmod._EXEC_CACHE)
+    # The SHAP view rides the same with_shap=True executable; the int8 pack
+    # must get its OWN entry (precision + table hash key the cache).
+    part.compile_shap(pack, F, 32, kernel="fused")
+    assert len(pmod._EXEC_CACHE) == before
+    part.compile_fused(pack_forest(forest, F, "int8"), F, 32)
+    assert len(pmod._EXEC_CACHE) == before + 1
+
+
+# --- serving integration ------------------------------------------------------
+
+
+def _cfg(**kw):
+    from cobalt_smart_lender_ai_tpu.config import ServeConfig
+
+    kw.setdefault("precompile_batch_buckets", ())
+    kw.setdefault("prewarm_all_buckets", False)
+    return ServeConfig(**kw)
+
+
+def test_score_cache_never_aliases_across_precisions(serving_artifact):
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, X = serving_artifact
+    f32 = ScorerService.from_store(store, _cfg(microbatch_enabled=False))
+    int8 = ScorerService.from_store(
+        store, _cfg(microbatch_enabled=False, forest_precision="int8")
+    )
+    try:
+        m32, m8 = f32._model, int8._model
+        row = {"amount": 1.0}
+        assert m32.cache_salt != m8.cache_salt
+        # Identical feature bytes produce different cache keys.
+        key32 = m32.cache_salt + m32.rows_array([row]).tobytes()
+        key8 = m8.cache_salt + m8.rows_array([row]).tobytes()
+        assert key32 != key8
+        assert m8.quant_table_hash not in ("", "f32")
+    finally:
+        f32.close()
+        int8.close()
+
+
+def test_reference_kernels_opt_out(serving_artifact):
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(
+        store, _cfg(microbatch_enabled=False, fused_kernels=False)
+    )
+    try:
+        _, payload = svc.ready()
+        assert payload["kernels"]["active"] == "reference"
+        assert payload["kernels"]["fused_dispatch"] is False
+        assert set(payload["kernels"]["buckets"].values()) == {"reference"}
+    finally:
+        svc.close()
+
+
+def test_quantized_requires_fused(serving_artifact):
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, _ = serving_artifact
+    with pytest.raises(ValueError, match="requires the fused kernel"):
+        ScorerService.from_store(
+            store,
+            _cfg(
+                microbatch_enabled=False,
+                fused_kernels=False,
+                forest_precision="int8",
+            ),
+        )
+
+
+def test_live_http_smoke_fused_programs(serving_artifact):
+    """End-to-end over the wire: score once through the micro-batcher, then
+    assert the observatory saw fused programs and /readyz reports the
+    kernel block — the ISSUE's serving acceptance in one smoke."""
+    from cobalt_smart_lender_ai_tpu.data import schema
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import make_async_server
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, X = serving_artifact
+    svc = ScorerService.from_store(store, _cfg())
+    server = make_async_server(svc, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        payload = {
+            name: float(v)
+            for name, v in zip(schema.SERVING_FEATURES, np.asarray(X[0]))
+        }
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+            body = json.loads(resp.read().decode())
+        assert 0.0 <= body["prob_default"] <= 1.0
+        assert body.get("shap_values") is not None  # fused dispatch carried phis
+
+        with urllib.request.urlopen(base + "/debug/programs", timeout=30) as r:
+            progs = json.loads(r.read().decode())
+        names = [p["name"] for p in progs["programs"]]
+        assert any(n.startswith("serve.fused[") for n in names), names
+
+        with urllib.request.urlopen(base + "/readyz", timeout=30) as r:
+            ready = json.loads(r.read().decode())
+        kernels = ready["kernels"]
+        assert kernels["active"] == "fused"
+        assert kernels["precision"] == "f32"
+        assert kernels["fused_dispatch"] is True
+        assert "fused" in set(kernels["buckets"].values())
+    finally:
+        server.close()
+        svc.close()

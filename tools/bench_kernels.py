@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Scoring-kernel bench: the fused one-dispatch Pallas program against the
+classic margin + SHAP program pair, at serving micro-batch bucket sizes,
+per forest precision.
+
+``reference`` is what the micro-batcher dispatched per coalesced batch
+before the fused kernel: one `predict_margin` program THEN one
+`shap_values` program (two device round-trips). ``fused`` is the one-pass
+`ops/score_pallas.py` program — traversal + margin + sigmoid + SHAP
+phi-accumulation in a single dispatch. Both sides are AOT-compiled through
+the partitioner (one untimed warmup pays compiles), then the best of
+``--repeats`` timed dispatches is kept (BENCH_BULK precedent).
+
+The reference contraction only runs the exact f32 forest, so the record
+carries one reference column (under ``f32``) and a fused column per
+precision; bf16/int8 cells also report their margin deltas vs f32 so the
+speed number never hides an accuracy regression.
+
+Honest caveat, as prior BENCH files note: this container is a ~1-core CPU
+host running the Pallas kernel in *interpret mode* — absolute numbers say
+nothing about TPU wall time, and interpret-mode overhead flatters neither
+side equally. The relative fused-vs-reference ratio is still the metric
+the ``--check`` gate (CI kernel-smoke job) holds: the fused dispatch must
+not be slower than the program pair it replaces.
+
+    python tools/bench_kernels.py --out BENCH_KERNEL_r01.json
+    python tools/perf_sentinel.py ingest BENCH_KERNEL_r01.json --no-stamp
+    python tools/bench_kernels.py --check BENCH_KERNEL_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: Serving-shaped workload: the conftest serving model's scale (25 trees,
+#: depth 3, 20 features) so the bench measures the bucket sizes the
+#: micro-batcher actually dispatches.
+N_TREES = 25
+DEPTH = 3
+N_FEATURES = 20
+
+
+def _platform_tag() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def _host_cpu_cores() -> int:
+    if hasattr(os, "sched_getaffinity"):
+        return len(os.sched_getaffinity(0))
+    return os.cpu_count() or 1
+
+
+def _time_best(fn, repeats: int) -> float:
+    fn()  # warmup: compiles, caches, page-in
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run_kernel_bench(
+    buckets: list[int], *, repeats: int, precisions: list[str]
+) -> dict:
+    import jax
+    import numpy as np
+
+    from cobalt_smart_lender_ai_tpu.models.gbdt import GBDTClassifier
+    from cobalt_smart_lender_ai_tpu.ops.score_pallas import (
+        pack_forest,
+        quantization_report,
+    )
+    from cobalt_smart_lender_ai_tpu.parallel.partitioner import (
+        SingleDevicePartitioner,
+    )
+
+    rng = np.random.default_rng(19)
+    X = rng.normal(size=(4096, N_FEATURES)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] - 0.3 * X[:, 2] > 0).astype(np.int32)
+    model = GBDTClassifier(
+        n_estimators=N_TREES, max_depth=DEPTH, n_bins=64
+    ).fit(X, y)
+    forest = model.forest
+
+    part = SingleDevicePartitioner(kind_prefix="bench")
+    results: dict[str, dict] = {}
+    for precision in precisions:
+        pack = pack_forest(forest, N_FEATURES, precision)
+        quant = (
+            None
+            if precision == "f32"
+            else quantization_report(forest, pack, N_FEATURES)
+        )
+        per_bucket: dict[str, dict] = {}
+        for bucket in buckets:
+            xb = X[:bucket]
+            fused_fn = part.compile_fused(
+                pack, N_FEATURES, bucket, with_shap=True
+            )
+
+            def fused_pass():
+                jax.block_until_ready(fused_fn(xb))
+
+            cell: dict[str, dict] = {}
+            fused_s = _time_best(fused_pass, repeats)
+            cell["fused"] = {
+                "dispatch_seconds": round(fused_s, 6),
+                "rows_per_s": round(bucket / fused_s, 1),
+            }
+            if precision == "f32":
+                margin_fn = part.compile_margin(
+                    forest, N_FEATURES, bucket, kernel="reference"
+                )
+                shap_fn = part.compile_shap(
+                    forest, N_FEATURES, bucket, kernel="reference"
+                )
+
+                def reference_pass():
+                    # The pre-fused serving hot path: margin dispatch,
+                    # sigmoid on host, then the SHAP dispatch.
+                    m = margin_fn(xb)
+                    np.asarray(jax.nn.sigmoid(m))
+                    jax.block_until_ready(shap_fn(xb))
+
+                ref_s = _time_best(reference_pass, repeats)
+                cell["reference"] = {
+                    "dispatch_seconds": round(ref_s, 6),
+                    "rows_per_s": round(bucket / ref_s, 1),
+                }
+                cell["speedup"] = round(ref_s / fused_s, 2)
+            per_bucket[str(bucket)] = cell
+            line = (
+                f"[bench] {precision} bucket={bucket}: "
+                f"fused {fused_s * 1e3:.2f}ms"
+            )
+            if "reference" in cell:
+                line += (
+                    f", reference {cell['reference']['dispatch_seconds'] * 1e3:.2f}ms"
+                    f" ({cell['speedup']}x)"
+                )
+            print(line, file=sys.stderr)
+        results[precision] = per_bucket
+        if quant is not None:
+            results[precision]["quantization"] = {
+                k: v for k, v in quant.items() if k != "tolerance"
+            }
+
+    return {
+        "bench": "score_kernels",
+        "forest": {
+            "n_trees": N_TREES,
+            "depth": DEPTH,
+            "n_features": N_FEATURES,
+        },
+        "repeats": repeats,
+        "platform": _platform_tag(),
+        "interpret_mode": _platform_tag() != "tpu",
+        "devices": len(jax.devices()),
+        "host_cpu_cores": _host_cpu_cores(),
+        "results": results,
+    }
+
+
+def check_record(record: dict, *, slack: float) -> int:
+    """The CI gate: at every bucket, the fused f32 dispatch must be no
+    slower than ``slack`` x the reference program pair it replaces."""
+    failures = []
+    f32 = (record.get("results") or {}).get("f32") or {}
+    for bucket, cell in f32.items():
+        if not isinstance(cell, dict) or "reference" not in cell:
+            continue
+        fused_s = cell["fused"]["dispatch_seconds"]
+        ref_s = cell["reference"]["dispatch_seconds"]
+        if fused_s > ref_s * slack:
+            failures.append(
+                f"bucket {bucket}: fused {fused_s:.6f}s > "
+                f"{slack:g}x reference {ref_s:.6f}s"
+            )
+    if failures:
+        print("KERNEL GATE FAIL", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print("kernel gate ok: fused <= reference at every bucket",
+          file=sys.stderr)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--buckets", default="16,64,256",
+                        help="comma-separated serving bucket sizes")
+    parser.add_argument("--repeats", type=int, default=5,
+                        help="timed dispatches per cell (best is kept)")
+    parser.add_argument("--precisions", default="f32,bf16,int8",
+                        help="comma-separated forest precisions")
+    parser.add_argument("--out", default=None,
+                        help="write the record here (default: stdout)")
+    parser.add_argument("--check", default=None, metavar="RECORD",
+                        help="gate an existing record instead of running: "
+                        "fused f32 dispatch <= --slack x reference")
+    parser.add_argument("--slack", type=float, default=1.0,
+                        help="multiplier the fused dispatch may not exceed "
+                        "over the reference pair in --check")
+    parser.add_argument("--force-devices", type=int, default=None,
+                        help="set --xla_force_host_platform_device_count "
+                        "before JAX loads (no-op if JAX is already up)")
+    args = parser.parse_args(argv)
+
+    if args.check:
+        with open(args.check) as fh:
+            return check_record(json.load(fh), slack=args.slack)
+
+    if args.force_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.force_devices}"
+        ).strip()
+
+    buckets = sorted(int(b) for b in args.buckets.split(",") if b.strip())
+    precisions = [p.strip() for p in args.precisions.split(",") if p.strip()]
+    record = run_kernel_bench(
+        buckets, repeats=args.repeats, precisions=precisions
+    )
+    text = json.dumps(record)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
