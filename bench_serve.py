@@ -53,6 +53,31 @@ def _percentile(samples: list[float], q: float) -> float:
     return samples[idx]
 
 
+def _fetch_events(port: int, **params) -> dict:
+    """``GET /events`` against the bench server — the fleet's event
+    journal is the bench's source of truth for control-plane state
+    (heals, resizes, brownout rungs), read over the same HTTP surface an
+    operator would use instead of reaching into fleet internals."""
+    import urllib.request
+    from urllib.parse import urlencode
+
+    qs = urlencode({k: v for k, v in params.items() if v is not None})
+    url = f"http://127.0.0.1:{port}/events" + (f"?{qs}" if qs else "")
+    with urllib.request.urlopen(url, timeout=5.0) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _events_block(port: int) -> dict | None:
+    """The committed record's journal snapshot (``events.journal`` +
+    ``events.stats``) — the input `tools/incident_report.py` stitches
+    into a postmortem. ``None`` if the server is already gone."""
+    try:
+        doc = _fetch_events(port)
+    except Exception:
+        return None
+    return {"journal": doc.get("events", []), "stats": doc.get("stats", {})}
+
+
 def build_service(config, n_rows: int, seed: int = 7):
     """Train a small serving-contract model and wrap it in a `ScorerService`
     (the conftest `serving_artifact` recipe, minus the object store)."""
@@ -780,16 +805,32 @@ def run_chaos_bench(
             f"[bench] chaos: kill + {hang_s:g}s hang on replica {target}",
             file=sys.stderr,
         )
-        rebuilds = fleet.supervisor._m_rebuilds.labels(
-            replica=str(target), outcome="ok"
-        )
+        # Heal detection over GET /events: the target replica is healed
+        # when the journal shows a transition back to "healthy" after its
+        # quarantine — the same causal record `tools/incident_report.py`
+        # reads, observed through the operator's HTTP surface rather than
+        # by reaching into fleet internals.
         give_up = chaos_at[0] + heal_timeout_s
         while time.monotonic() < give_up:
-            if rebuilds.value >= 1 and all(
-                h.state == HEALTHY for h in fleet.replica_health
-            ):
-                healed_in[0] = round(time.monotonic() - chaos_at[0], 3)
-                return
+            try:
+                doc = _fetch_events(
+                    port, component="supervisor", kind="transition"
+                )
+            except Exception:
+                time.sleep(0.1)
+                continue
+            quarantined = False
+            for event in doc.get("events", []):  # oldest-first
+                if event.get("replica") != target:
+                    continue
+                to = (event.get("payload") or {}).get("to")
+                if to == "quarantined":
+                    quarantined = True
+                elif to == "healthy" and quarantined:
+                    healed_in[0] = round(
+                        time.monotonic() - chaos_at[0], 3
+                    )
+                    return
             time.sleep(0.05)
 
     sab = threading.Thread(target=saboteur, daemon=True)
@@ -808,6 +849,7 @@ def run_chaos_bench(
             warmup_s=warmup_s,
         )
         sab.join(timeout=heal_timeout_s + 5.0)
+        events_block = _events_block(port)
     finally:
         shutdown()
     h = fleet.replica_health[target]
@@ -854,6 +896,7 @@ def run_chaos_bench(
         "load": row,
         "chaos": chaos_block,
         "supervisor": supervisor_block,
+        "events": events_block,
         "platform": _platform_tag(),
         "host_cpu_cores": len(os.sched_getaffinity(0))
         if hasattr(os, "sched_getaffinity")
@@ -987,14 +1030,34 @@ def run_traffic_bench(
     async def sampler(stop_at: float) -> None:
         # replica-count / brownout-level timeline alongside the load — the
         # committed record shows the control loop acting, not just its
-        # end-state counters.
+        # end-state counters. Both series are *derived from the event
+        # journal* over GET /events (resize payload "to", brownout payload
+        # "level"): if an actuation ever failed to journal, this timeline
+        # would go flat and the record would show it.
         loop = asyncio.get_running_loop()
+        replicas_now, level_now = start_replicas, 0
         while loop.time() < stop_at:
+            try:
+                doc = await loop.run_in_executor(
+                    None,
+                    lambda: _fetch_events(port, component="autoscaler"),
+                )
+                replicas_now, level_now = start_replicas, 0
+                for event in doc.get("events", []):  # oldest-first
+                    payload = event.get("payload") or {}
+                    if event.get("kind") == "resize":
+                        replicas_now = int(
+                            payload.get("to", replicas_now)
+                        )
+                    elif event.get("kind") == "brownout":
+                        level_now = int(payload.get("level", level_now))
+            except Exception:
+                pass  # server mid-bind or draining: keep last-known state
             timeline.append(
                 {
                     "t": round(time.monotonic() - t0[0], 2),
-                    "replicas": len(fleet.replicas),
-                    "brownout_level": fleet.brownout.level,
+                    "replicas": replicas_now,
+                    "brownout_level": level_now,
                 }
             )
             await asyncio.sleep(0.5)
@@ -1070,6 +1133,7 @@ def run_traffic_bench(
     )
     try:
         asyncio.run(drive())
+        events_block = _events_block(port)
     finally:
         shutdown()
     scaler = fleet.autoscaler
@@ -1119,6 +1183,7 @@ def run_traffic_bench(
             "max_ms": round(singles[-1], 3) if singles else float("nan"),
         },
         "autoscaler": autoscaler_block,
+        "events": events_block,
         "platform": _platform_tag(),
         "host_cpu_cores": len(os.sched_getaffinity(0))
         if hasattr(os, "sched_getaffinity")

@@ -391,6 +391,13 @@ class ServeConfig:
     flight_capacity: int = 256
     flight_slow_threshold_ms: float = 100.0
     flight_top_k: int = 32
+    #: Event journal (telemetry.events, served at ``GET /events``): bounded
+    #: ring of typed control-plane events (quarantines, resizes, brownouts,
+    #: canary flips, reloads, breaker trips, chaos injections) with causal
+    #: links. ``events_ship_interval_s`` only matters when a durable store
+    #: is attached; <= 0 disables shipping.
+    events_capacity: int = 512
+    events_ship_interval_s: float = 30.0
     #: Telemetry history (telemetry.timeseries, served at ``GET /history``
     #: and ``GET /dashboard``): a background sampler scrapes the service
     #: registry every ``history_interval_s`` into tiered downsampled rings

@@ -68,10 +68,17 @@ class CircuitBreaker:
         self.transitions: list[str] = []
         self.opened_count = 0
         self.fast_failures = 0  # calls rejected without touching the store
+        #: Optional observer ``(old_state, new_state) -> None`` invoked on
+        #: every transition (the serving layer journals breaker flips as
+        #: control-plane events). Runs under the breaker lock, so it must
+        #: not call back into the breaker; any exception it raises is
+        #: swallowed — observation never breaks the state machine.
+        self.on_transition: Callable[[str, str], None] | None = None
 
     # -- state machine (lock held for every mutation) --------------------------
 
     def _transition_locked(self, to: str) -> None:
+        old = self._state
         self._state = to
         self.transitions.append(to)
         if to == OPEN:
@@ -81,6 +88,11 @@ class CircuitBreaker:
             self._consecutive_failures = 0
         elif to == HALF_OPEN:
             self._probes_in_flight = 0
+        if self.on_transition is not None:
+            try:
+                self.on_transition(old, to)
+            except Exception:
+                pass
 
     def _poll_locked(self) -> str:
         """Advance open → half-open once the reset timeout has elapsed."""
@@ -95,6 +107,10 @@ class CircuitBreaker:
     def state(self) -> str:
         with self._lock:
             return self._poll_locked()
+
+    @property
+    def consecutive_failures(self) -> int:
+        return self._consecutive_failures
 
     def _reject_locked(self) -> None:
         from cobalt_smart_lender_ai_tpu.reliability.errors import (

@@ -129,6 +129,12 @@ class ChaosPlan:
         self._released = threading.Event()
         self.events: Counter[str] = Counter()
         self.last_event_at: dict[str, float] = {}
+        # The injected fleet's EventJournal (picked up by `inject`): every
+        # fault lands in the control-plane record as a ``chaos.inject``
+        # event, so a postmortem shows the kill right before the
+        # quarantine it provoked. Weakref — chaos must never keep a fleet
+        # alive.
+        self._journal_ref: Callable[[], object | None] = lambda: None
         self._register_metrics(
             registry if registry is not None else default_registry()
         )
@@ -178,6 +184,9 @@ class ChaosPlan:
         """Attach to every replica batcher of ``target`` (a `ReplicaSet` or a
         single `ScorerService`, treated as replica 0)."""
         replicas = getattr(target, "replicas", None) or [target]
+        journal = getattr(target, "journal", None)
+        if journal is not None:
+            self._journal_ref = weakref.ref(journal)
         for i, rep in enumerate(replicas):
             batcher = getattr(rep, "batcher", None)
             if batcher is None:
@@ -199,9 +208,21 @@ class ChaosPlan:
         self._hooked.clear()
 
     # -- the injection engine (called from worker threads) --------------------
-    def _record(self, kind: str) -> None:
+    def _record(self, kind: str, replica: int | None = None) -> None:
         self.events[kind] += 1
         self.last_event_at[kind] = self._clock()
+        journal = self._journal_ref()
+        if journal is not None:
+            try:
+                journal.emit(
+                    "chaos",
+                    "inject",
+                    replica=replica,
+                    payload={"fault": kind},
+                    cause={"plan": "chaos", "fault": kind},
+                )
+            except Exception:
+                pass  # chaos must inject its fault even if journaling fails
 
     def _hang(self, duration: float) -> None:
         # Under the default real sleep, hang on the release event so
@@ -230,16 +251,16 @@ class ChaosPlan:
             if spec.delay_s or spec.delay_jitter_s:
                 delay = spec.delay_s + spec.delay_jitter_s * self._rng.random()
                 a.spent += 1
-                self._record("delay")
+                self._record("delay", replica)
                 self._sleep(delay)
             if spec.hang_s and a.budget_left():
                 a.spent += 1
-                self._record("hang")
+                self._record("hang", replica)
                 _LOG.warning("chaos_hang", replica=replica, hang_s=spec.hang_s)
                 self._hang(spec.hang_s)
             if spec.kill_worker and a.budget_left():
                 a.spent += 1
-                self._record("kill")
+                self._record("kill", replica)
                 _LOG.warning("chaos_kill_worker", replica=replica)
                 raise WorkerKilled(f"chaos killed replica {replica} worker")
             storm = spec.error_rate and (
@@ -247,7 +268,7 @@ class ChaosPlan:
             )
             if storm and a.budget_left() and self._rng.random() < spec.error_rate:
                 a.spent += 1
-                self._record("error")
+                self._record("error", replica)
                 raise ChaosError(
                     f"chaos error storm on replica {replica} "
                     f"(dispatch {a.dispatches})"

@@ -51,7 +51,11 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
     RequestShed,
     ValidationError,
 )
-from cobalt_smart_lender_ai_tpu.telemetry import default_tracer, get_logger
+from cobalt_smart_lender_ai_tpu.telemetry import (
+    default_tracer,
+    event_context,
+    get_logger,
+)
 
 if TYPE_CHECKING:  # pragma: no cover - import cycle guard (replicas -> here)
     from cobalt_smart_lender_ai_tpu.serve.replicas import ReplicaSet
@@ -102,36 +106,60 @@ class BrownoutLadder:
         self.engaged_total = 0
         self.released_total = 0
         self._lock = threading.Lock()
+        #: Optional `telemetry.events.EventJournal` — `ReplicaSet` assigns
+        #: the fleet's, so every rung change lands in the control-plane
+        #: record no matter who drove the ladder (autoscaler or operator).
+        self.journal = None
 
-    def engage(self, reason: str = "") -> tuple[int, int] | None:
+    def _journal_step(
+        self, direction: str, reason: str, cause
+    ) -> int | None:
+        if self.journal is None:
+            return None
+        eid = self.journal.emit(
+            "autoscaler",
+            "brownout",
+            payload={
+                "direction": direction,
+                "level": self.level,
+                "rung": BROWNOUT_RUNGS[self.level],
+            },
+            cause=cause if cause is not None else {"reason": reason},
+        )
+        return eid
+
+    def engage(self, reason: str = "", *, cause=None) -> tuple[int, int] | None:
         """Step one rung down the ladder; returns ``(old, new)`` or None at
-        the configured ceiling."""
+        the configured ceiling. ``cause`` is the trigger snapshot for the
+        journal (the autoscaler passes its load signals)."""
         with self._lock:
             if self.level >= self.max_level:
                 return None
             old, self.level = self.level, self.level + 1
             self.engaged_total += 1
-        _LOG.warning(
-            "brownout_engage",
-            level=self.level,
-            rung=BROWNOUT_RUNGS[self.level],
-            reason=reason,
-        )
+        with event_context(self._journal_step("engage", reason, cause)):
+            _LOG.warning(
+                "brownout_engage",
+                level=self.level,
+                rung=BROWNOUT_RUNGS[self.level],
+                reason=reason,
+            )
         return old, self.level
 
-    def release(self, reason: str = "") -> tuple[int, int] | None:
+    def release(self, reason: str = "", *, cause=None) -> tuple[int, int] | None:
         """Step one rung back up; returns ``(old, new)`` or None at 0."""
         with self._lock:
             if self.level <= 0:
                 return None
             old, self.level = self.level, self.level - 1
             self.released_total += 1
-        _LOG.info(
-            "brownout_release",
-            level=self.level,
-            rung=BROWNOUT_RUNGS[self.level],
-            reason=reason,
-        )
+        with event_context(self._journal_step("release", reason, cause)):
+            _LOG.info(
+                "brownout_release",
+                level=self.level,
+                rung=BROWNOUT_RUNGS[self.level],
+                reason=reason,
+            )
         return old, self.level
 
     @property
@@ -362,7 +390,8 @@ class FleetAutoscaler:
                 # burning: degrade one rung instead of collapsing.
                 step = self.brownout.engage(
                     f"fast_burn at {n} replicas (max "
-                    f"{cfg.autoscaler_max_replicas})"
+                    f"{cfg.autoscaler_max_replicas})",
+                    cause=sig,
                 )
                 if step is not None:
                     self._m_brownouts.labels(direction="engage").inc()
@@ -374,7 +403,7 @@ class FleetAutoscaler:
             # (strictly symmetric with engagement) before any capacity is
             # retired — full service first, savings second.
             if self.brownout.level > 0:
-                step = self.brownout.release("load cleared")
+                step = self.brownout.release("load cleared", cause=sig)
                 if step is not None:
                     self._m_brownouts.labels(direction="release").inc()
                     summary["actions"].append(
@@ -442,12 +471,20 @@ class FleetAutoscaler:
                 error=f"{type(exc).__name__}: {exc}",
             )
             return False
-        i = fleet.add_replica(replica)
-        self._last_scale_up_at = self._clock()
-        self._m_resizes.labels(direction="up").inc()
-        _LOG.info(
-            "autoscaler_scale_up", replica=i, replicas=len(fleet.replicas)
+        eid = fleet.journal.emit(
+            "autoscaler",
+            "resize",
+            payload={"direction": "up", "from": n, "to": n + 1},
+            cause=self._last_signals or {"trigger": "forced"},
         )
+        with event_context(eid):
+            # add_replica's admission.rescale event chains to this resize
+            i = fleet.add_replica(replica)
+            self._last_scale_up_at = self._clock()
+            self._m_resizes.labels(direction="up").inc()
+            _LOG.info(
+                "autoscaler_scale_up", replica=i, replicas=len(fleet.replicas)
+            )
         return True
 
     def _scale_down(self) -> bool:
@@ -467,11 +504,24 @@ class FleetAutoscaler:
         self._last_scale_down_at = self._clock()
         self._idle_ticks = 0
         self._m_resizes.labels(direction="down").inc()
-        _LOG.info(
-            "autoscaler_scale_down",
+        eid = fleet.journal.emit(
+            "autoscaler",
+            "resize",
             replica=result["replica"],
-            replicas=result["replicas"],
+            payload={
+                "direction": "down",
+                "from": result["replicas"] + 1,
+                "to": result["replicas"],
+                "drained": result["drained"],
+            },
+            cause=self._last_signals or {"trigger": "forced"},
         )
+        with event_context(eid):
+            _LOG.info(
+                "autoscaler_scale_down",
+                replica=result["replica"],
+                replicas=result["replicas"],
+            )
         return True
 
     def _retune(self, *, busy: bool, summary: dict) -> None:
@@ -505,13 +555,25 @@ class FleetAutoscaler:
         if retuned:
             self._m_retunes.labels(profile=profile).inc()
             summary["actions"].append(f"retune:{profile}")
-            _LOG.info(
-                "autoscaler_retune",
-                profile=profile,
-                max_wait_ms=wait_s * 1000.0,
-                max_rows=rows,
-                replicas=retuned,
+            eid = self.fleet.journal.emit(
+                "autoscaler",
+                "retune",
+                payload={
+                    "profile": profile,
+                    "max_wait_ms": wait_s * 1000.0,
+                    "max_rows": rows,
+                    "replicas": retuned,
+                },
+                cause=summary.get("signals"),
             )
+            with event_context(eid):
+                _LOG.info(
+                    "autoscaler_retune",
+                    profile=profile,
+                    max_wait_ms=wait_s * 1000.0,
+                    max_rows=rows,
+                    replicas=retuned,
+                )
 
     # -- admin / observability -------------------------------------------------
 

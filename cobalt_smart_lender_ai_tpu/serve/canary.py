@@ -51,7 +51,7 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
     RequestError,
     RollbackFailed,
 )
-from cobalt_smart_lender_ai_tpu.telemetry import get_logger
+from cobalt_smart_lender_ai_tpu.telemetry import event_context, get_logger
 from cobalt_smart_lender_ai_tpu.telemetry.drift import FeatureSketch
 
 _LOG = get_logger("cobalt.serve.canary")
@@ -140,6 +140,15 @@ class CanaryController:
             target=self._run, name="canary-shadow", daemon=True
         )
         self._worker.start()
+
+    def _journal_emit(self, kind: str, **kw) -> int | None:
+        """Journal a canary action on the owning service/fleet's journal.
+        Returns the event id, or None when the owner has no journal (bare
+        test doubles)."""
+        journal = getattr(self._service, "journal", None)
+        if journal is None:
+            return None
+        return journal.emit("canary", kind, **kw)
 
     # -- metrics --------------------------------------------------------------
 
@@ -450,11 +459,18 @@ class CanaryController:
                     "version": ptr["version"],
                     "gate": report,
                 }
-                _LOG.warning(
-                    "canary_promotion_rejected",
-                    version=ptr["version"],
-                    reasons=report["reasons"],
+                eid = self._journal_emit(
+                    "reject",
+                    model=f"v{ptr['version']}",
+                    payload={"reasons": report["reasons"]},
+                    cause={"gate": report},
                 )
+                with event_context(eid):
+                    _LOG.warning(
+                        "canary_promotion_rejected",
+                        version=ptr["version"],
+                        reasons=report["reasons"],
+                    )
                 raise PromotionRejected(
                     "promotion gate rejected canary "
                     f"v{ptr['version']}: {', '.join(report['reasons'])}",
@@ -494,7 +510,16 @@ class CanaryController:
                 "gate": report,
                 "guard": self._guard,
             }
-            _LOG.info("canary_promoted", **{k: v for k, v in flip.items()})
+            eid = self._journal_emit(
+                "promote",
+                model=f"v{flip['promoted_version']}",
+                payload=dict(flip),
+                cause={"gate": report, "forced": force},
+            )
+            with event_context(eid):
+                _LOG.info(
+                    "canary_promoted", **{k: v for k, v in flip.items()}
+                )
             return {"status": "promoted", **flip, "gate": report,
                     "reload": result}
 
@@ -534,7 +559,14 @@ class CanaryController:
         self._m_rollbacks.labels(trigger=trigger).inc()
         self.last_promotion = {"action": "rolled_back", **flip,
                                "trigger": trigger}
-        _LOG.warning("model_rollback", trigger=trigger, **flip)
+        eid = self._journal_emit(
+            "rollback",
+            model=f"v{flip['restored_version']}",
+            payload=dict(flip),
+            cause={"trigger": trigger, "reason": reason},
+        )
+        with event_context(eid):
+            _LOG.warning("model_rollback", trigger=trigger, **flip)
         return {"status": "rolled_back", "trigger": trigger, **flip,
                 "reload": result}
 

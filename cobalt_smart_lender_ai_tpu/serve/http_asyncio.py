@@ -66,6 +66,7 @@ from cobalt_smart_lender_ai_tpu.serve.http_stdlib import (
     _extract_csv,
     dashboard_html,
     debug_programs_payload,
+    events_payload,
     history_payload,
     validate_debug_limit,
     validate_debug_phase,
@@ -707,6 +708,29 @@ class AsyncScorerServer:
                         st.query.get("series", [None])[-1],
                         st.query.get("window", [None])[-1],
                         st.query.get("step", [None])[-1],
+                    ),
+                )
+        elif path == "/events":
+            journal = getattr(service, "journal", None)
+            if journal is None:
+                await self._send(
+                    st,
+                    404,
+                    {
+                        "detail": "events disabled",
+                        "error": "events_disabled",
+                    },
+                )
+            else:
+                await self._send(
+                    st,
+                    200,
+                    events_payload(
+                        service,
+                        st.query.get("component", [None])[-1],
+                        st.query.get("kind", [None])[-1],
+                        st.query.get("since", [None])[-1],
+                        st.query.get("limit", [None])[-1],
                     ),
                 )
         elif path == "/dashboard":

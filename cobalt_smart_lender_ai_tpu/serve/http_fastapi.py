@@ -539,6 +539,27 @@ def create_app(service: ScorerService | None = None, store_uri: str | None = Non
         except RequestError as e:  # malformed params / unknown series -> 422
             _raise_typed(e)
 
+    @app.get("/events")
+    def events(
+        component: str = None,
+        kind: str = None,
+        since: str = None,
+        limit: str = None,
+    ):
+        from cobalt_smart_lender_ai_tpu.serve.http_stdlib import (
+            events_payload,
+        )
+
+        service = state["service"]
+        if getattr(service, "journal", None) is None:
+            exc = HTTPException(status_code=404, detail="events disabled")
+            exc.cobalt_code = "events_disabled"
+            raise exc
+        try:
+            return events_payload(service, component, kind, since, limit)
+        except RequestError as e:  # unknown component/kind, bad since -> 422
+            _raise_typed(e)
+
     @app.get("/dashboard")
     def dashboard(window: str = None):
         from cobalt_smart_lender_ai_tpu.serve.http_stdlib import (

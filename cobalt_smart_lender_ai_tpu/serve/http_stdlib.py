@@ -17,6 +17,8 @@ import so the route taxonomy can never drift between them:
   bounds of the ``GET /debug/*`` query params;
 - `validate_history_params` / `history_payload` — the typed-422 bounds
   and body of ``GET /history`` (telemetry.timeseries);
+- `validate_events_params` / `events_payload` — the typed-422 bounds
+  and body of ``GET /events`` (telemetry.events);
 - `dashboard_html` — the ``GET /dashboard`` page body;
 - `debug_programs_payload` — the ``GET /debug/programs`` body;
 - `_extract_csv` — multipart/raw CSV extraction for the bulk route.
@@ -60,6 +62,7 @@ _KNOWN_ROUTES = frozenset(
         "/debug/trace",
         "/debug/programs",
         "/history",
+        "/events",
         "/dashboard",
     }
 )
@@ -131,6 +134,80 @@ def history_payload(
             f"unknown series {series!r}; GET /history without params "
             "lists every available series"
         )
+
+
+def validate_events_params(
+    component: str | None,
+    kind: str | None,
+    since: str | None,
+    limit: str | None,
+) -> tuple[str | None, str | None, float | None, int | None]:
+    """Shared ``GET /events`` query validation. ``component``/``kind``
+    must come from the `telemetry.events.EVENT_KINDS` taxonomy (``kind``
+    additionally scoped to the component when both are given), ``since``
+    is a finite wall timestamp in seconds, ``limit`` uses the shared
+    debug bound — anything else is the same typed 422 both adapters
+    emit."""
+    from cobalt_smart_lender_ai_tpu.telemetry.events import EVENT_KINDS
+
+    if component is not None and component not in EVENT_KINDS:
+        raise ValidationError(
+            f"query param 'component' must be one of {sorted(EVENT_KINDS)}"
+        )
+    if kind is not None:
+        scope = (
+            EVENT_KINDS[component]
+            if component is not None
+            else tuple(k for ks in EVENT_KINDS.values() for k in ks)
+        )
+        if kind not in scope:
+            raise ValidationError(
+                f"query param 'kind' must be one of {sorted(set(scope))}"
+            )
+    since_t: float | None = None
+    if since is not None:
+        try:
+            since_t = float(since)
+        except ValueError:
+            raise ValidationError(
+                "query param 'since' must be a timestamp in seconds"
+            )
+        if not math.isfinite(since_t):
+            raise ValidationError(
+                "query param 'since' must be a finite timestamp in seconds"
+            )
+    limit_n: int | None = None
+    if limit is not None:
+        try:
+            limit_n = int(limit)
+        except ValueError:
+            raise ValidationError("query param 'limit' must be an integer")
+        validate_debug_limit(limit_n)
+    return component, kind, since_t, limit_n
+
+
+def events_payload(
+    owner: Any,
+    component: str | None,
+    kind: str | None,
+    since: str | None,
+    limit: str | None,
+) -> dict:
+    """``GET /events`` body, shared by both adapters. ``owner`` is the
+    service or fleet — its ``events()`` method is the (possibly
+    fleet-merged) journal snapshot, and ``journal.stats()`` rides along
+    so the journal's own health is visible where its contents are."""
+    component, kind, since_t, limit_n = validate_events_params(
+        component, kind, since, limit
+    )
+    events = owner.events(
+        component=component, kind=kind, since=since_t, limit=limit_n
+    )
+    return {
+        "events": events,
+        "count": len(events),
+        "stats": owner.journal.stats(),
+    }
 
 
 def dashboard_html(history: Any, *, window: str | None = None) -> str:

@@ -70,13 +70,16 @@ from cobalt_smart_lender_ai_tpu.serve.supervisor import (
     replica_internal,
 )
 from cobalt_smart_lender_ai_tpu.telemetry import (
+    EventJournal,
     FlightRecorder,
     MetricsRegistry,
     SLOEngine,
     add_phase,
     default_objectives,
     default_tracer,
+    event_context,
     get_logger,
+    merge_events,
 )
 
 __all__ = ["ReplicaSet", "resolve_replica_devices"]
@@ -170,6 +173,20 @@ class ReplicaSet:
             slow_threshold_s=config.flight_slow_threshold_ms / 1000.0,
             top_k=config.flight_top_k,
         )
+        # Fleet control-plane journal (telemetry.events): supervisor
+        # transitions, resizes, brownout rungs, canary flips, chaos
+        # injections. Event ids are minted process-wide, so GET /events
+        # fleet-merges this journal with every replica's by a plain sort.
+        self.journal = EventJournal(
+            capacity=config.events_capacity,
+            ship_interval_s=config.events_ship_interval_s,
+            registry=self.registry,
+        )
+        self.brownout.journal = self.journal
+        # Latest transition event per replica slot — the heal path chains
+        # its rebuild/swap/readmit events back to the quarantine that
+        # triggered them.
+        self._last_transition_event: dict[int, int] = {}
         self.slo: SLOEngine | None = None
         self._swap_lock = threading.Lock()
         self._last_reload: dict | None = None
@@ -249,6 +266,31 @@ class ReplicaSet:
         `ScorerService.start_history`."""
         if self.history is not None:
             self.history.start()
+        if self._store is not None:
+            if self.journal._store is None:
+                self.journal.attach_store(self._store)
+            self.journal.start()
+
+    def events(
+        self,
+        *,
+        component: str | None = None,
+        kind: str | None = None,
+        since: float | None = None,
+        limit: int | None = None,
+    ) -> list[dict]:
+        """Fleet-merged journal snapshot — the ``GET /events`` body: the
+        facade's own journal (supervisor/autoscaler/chaos events) plus
+        every replica's (reload/breaker events), one list ordered by the
+        process-wide event id."""
+        journals = [self.journal] + [rep.journal for rep in self.replicas]
+        return merge_events(
+            journals,
+            component=component,
+            kind=kind,
+            since=since,
+            limit=limit,
+        )
 
     def start_supervisor(self) -> None:
         """Start the supervision probe loop (idempotent) — called by the
@@ -602,23 +644,53 @@ class ReplicaSet:
                     replica=str(i), trigger="auto"
                 ).inc()
 
-    def _note_transition(self, i: int, old: str, new: str) -> None:
-        """Every health transition is logged, traced, and counted."""
+    def _note_transition(
+        self,
+        i: int,
+        old: str,
+        new: str,
+        *,
+        cause: Mapping[str, Any] | None = None,
+        cause_id: int | None = None,
+    ) -> int:
+        """Every health transition is journaled, logged, traced, and
+        counted. Returns the journal event id so callers (the supervisor's
+        heal sequence) can chain downstream events to it. ``cause``
+        defaults to the trigger snapshot the state machine recorded — the
+        reason string plus the error EWMA at transition time."""
         h = self.replica_health[i]
         self._m_transitions.labels(replica=str(i), to=new).inc()
         with default_tracer().span(
             "supervisor.transition", replica=i, frm=old, to=new
         ):
             pass
-        log = _LOG.warning if new in (QUARANTINED, RESTARTING) else _LOG.info
-        log(
-            "replica_health_transition",
+        eid = self.journal.emit(
+            "supervisor",
+            "transition",
             replica=i,
-            frm=old,
-            to=new,
-            reason=h.reason,
-            error_ewma=round(h.error_ewma, 4),
+            payload={"from": old, "to": new, "reason": h.reason},
+            cause=(
+                dict(cause)
+                if cause is not None
+                else {
+                    "reason": h.reason,
+                    "error_ewma": round(h.error_ewma, 4),
+                }
+            ),
+            cause_id=cause_id,
         )
+        self._last_transition_event[i] = eid
+        log = _LOG.warning if new in (QUARANTINED, RESTARTING) else _LOG.info
+        with event_context(eid):
+            log(
+                "replica_health_transition",
+                replica=i,
+                frm=old,
+                to=new,
+                reason=h.reason,
+                error_ewma=round(h.error_ewma, 4),
+            )
+        return eid
 
     def _swap_replica(self, i: int, replacement: ScorerService) -> ScorerService:
         """Publish a rebuilt replica into routing slot ``i`` (the supervisor
@@ -655,7 +727,15 @@ class ReplicaSet:
             )
         self._register_replica_metrics(i)
         admission = self.admission.rescale(len(self.replicas))
-        _LOG.info("replica_added", replica=i, admission=admission)
+        eid = self.journal.emit(
+            "admission",
+            "rescale",
+            replica=i,
+            payload=dict(admission),
+            cause={"trigger": "replica_added", "replicas": i + 1},
+        )
+        with event_context(eid):
+            _LOG.info("replica_added", replica=i, admission=admission)
         return i
 
     def remove_replica(self, *, drain_timeout_s: float | None = None) -> dict:
@@ -711,13 +791,24 @@ class ReplicaSet:
                 target=old.close, daemon=True, name=f"replica-retire-{i}"
             ).start()
             admission = self.admission.rescale(len(self.replicas))
-            _LOG.info(
-                "replica_retired",
+            eid = self.journal.emit(
+                "admission",
+                "rescale",
                 replica=i,
-                replicas=len(self.replicas),
-                drained=drained,
-                admission=admission,
+                payload=dict(admission),
+                cause={
+                    "trigger": "replica_retired",
+                    "replicas": len(self.replicas),
+                },
             )
+            with event_context(eid):
+                _LOG.info(
+                    "replica_retired",
+                    replica=i,
+                    replicas=len(self.replicas),
+                    drained=drained,
+                    admission=admission,
+                )
             return {
                 "status": "retired",
                 "replica": i,
@@ -956,6 +1047,7 @@ class ReplicaSet:
             ),
             "per_replica": [p for _, p in per],
         }
+        payload["events"] = self.journal.stats()
         if self._last_reload is not None:
             payload["last_reload"] = self._last_reload
         payload["model"] = self.model_info
@@ -1000,10 +1092,26 @@ class ReplicaSet:
                     "error": f"{type(exc).__name__}: {exc}",
                 }
                 self._m_reloads.labels(status="rolled_back").inc()
-                _LOG.warning("fleet_reload", **self._last_reload)
+                eid = self.journal.emit(
+                    "reload",
+                    "rollback",
+                    model=key,
+                    payload=dict(self._last_reload),
+                    cause={"error": self._last_reload["error"]},
+                )
+                with event_context(eid):
+                    _LOG.warning("fleet_reload", **self._last_reload)
                 return self._last_reload
-            for rep, cand in zip(self.replicas, candidates):
-                rep._publish_candidate(cand, key)
+            eid = self.journal.emit(
+                "reload",
+                "publish",
+                model=key,
+                payload={"replicas": len(self.replicas), "model_key": key},
+            )
+            with event_context(eid):
+                # per-replica reload.publish events chain to the fleet's
+                for rep, cand in zip(self.replicas, candidates):
+                    rep._publish_candidate(cand, key)
             self._last_reload = {
                 "status": "ok",
                 "model_key": key,
@@ -1011,7 +1119,8 @@ class ReplicaSet:
                 "n_features": candidates[0].n_features,
             }
             self._m_reloads.labels(status="ok").inc()
-            _LOG.info("fleet_reload", **self._last_reload)
+            with event_context(eid):
+                _LOG.info("fleet_reload", **self._last_reload)
             return self._last_reload
 
     # -- continuous-training loop (serve.canary) -------------------------------
@@ -1205,6 +1314,7 @@ class ReplicaSet:
             self.canary.close()
         if self.history is not None:
             self.history.stop()
+        self.journal.stop()
         timeout = max(0.1, float(self.config.replica_close_timeout_s))
         closers = [
             threading.Thread(

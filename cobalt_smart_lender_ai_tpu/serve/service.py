@@ -96,6 +96,7 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
     WorkerDead,
 )
 from cobalt_smart_lender_ai_tpu.telemetry import (
+    EventJournal,
     FlightRecorder,
     MetricsRegistry,
     SLOEngine,
@@ -103,6 +104,7 @@ from cobalt_smart_lender_ai_tpu.telemetry import (
     current_request_id,
     default_objectives,
     default_tracer,
+    event_context,
     get_logger,
     request_context,
 )
@@ -1214,6 +1216,17 @@ class ScorerService:
             slow_threshold_s=self.config.flight_slow_threshold_ms / 1000.0,
             top_k=self.config.flight_top_k,
         )
+        # Control-plane event journal (telemetry.events, README "Incident
+        # forensics"): every reload/breaker/canary action this service
+        # takes lands here as a typed, causally-linked event, served at
+        # GET /events. Durable shipping is attached by the HTTP server
+        # (`start_history`) when a store is in play, mirroring history.
+        self.journal = EventJournal(
+            capacity=self.config.events_capacity,
+            ship_interval_s=self.config.events_ship_interval_s,
+            registry=self.registry,
+        )
+        self.store_breaker.on_transition = self._journal_breaker_transition
         self.slo: SLOEngine | None = None
         if self.config.slo_enabled:
             self.slo = SLOEngine(
@@ -1450,6 +1463,42 @@ class ScorerService:
         concern; in-process scoring shouldn't pay for a thread."""
         if self.history is not None:
             self.history.start()
+        # Same deal for journal shipping: only a served process durably
+        # ships its control-plane record (and only when a store exists).
+        if self._store is not None:
+            if self.journal._store is None:
+                self.journal.attach_store(self._store)
+            self.journal.start()
+
+    def _journal_breaker_transition(self, old: str, new: str) -> None:
+        """Breaker state flips -> journal events. Called from inside the
+        breaker's lock; the journal only takes its own lock and never
+        calls back, so there is no cycle."""
+        kind = {"closed": "close", "half_open": "half_open", "open": "open"}
+        brk = self.store_breaker
+        self.journal.emit(
+            "breaker",
+            kind.get(new, "open"),
+            payload={"breaker": brk.name, "from": old, "to": new},
+            cause={
+                "consecutive_failures": brk.consecutive_failures,
+                "opened_count": brk.opened_count,
+            },
+        )
+
+    def events(
+        self,
+        *,
+        component: str | None = None,
+        kind: str | None = None,
+        since: float | None = None,
+        limit: int | None = None,
+    ) -> list[dict]:
+        """Filtered journal snapshot — the ``GET /events`` body. On a
+        `ReplicaSet` the same-named method fleet-merges instead."""
+        return self.journal.events(
+            component=component, kind=kind, since=since, limit=limit
+        )
 
     def close(self) -> None:
         """Stop the micro-batch worker (drains queued requests first);
@@ -1461,6 +1510,7 @@ class ScorerService:
             self.batcher.close()
         if self.history is not None:
             self.history.stop()
+        self.journal.stop()
 
     # -- compiled-model delegation (stable public/observed surface) -----------
 
@@ -1661,7 +1711,14 @@ class ScorerService:
             "n_features": candidate.n_features,
         }
         self._m_reloads.labels(status="ok").inc()
-        _LOG.info("model_reload", **self._last_reload)
+        eid = self.journal.emit(
+            "reload",
+            "publish",
+            model=key,
+            payload=dict(self._last_reload),
+        )
+        with event_context(eid):
+            _LOG.info("model_reload", **self._last_reload)
         return self._last_reload
 
     def _record_rollback(self, key: str, exc: Exception) -> dict:
@@ -1671,7 +1728,15 @@ class ScorerService:
             "error": f"{type(exc).__name__}: {exc}",
         }
         self._m_reloads.labels(status="rolled_back").inc()
-        _LOG.warning("model_reload", **self._last_reload)
+        eid = self.journal.emit(
+            "reload",
+            "rollback",
+            model=key,
+            payload=dict(self._last_reload),
+            cause={"error": self._last_reload["error"]},
+        )
+        with event_context(eid):
+            _LOG.warning("model_reload", **self._last_reload)
         return self._last_reload
 
     # -- continuous-training loop (serve.canary) ------------------------------
@@ -1932,6 +1997,7 @@ class ScorerService:
         }
         if model.shap_error is not None:
             payload["shap_error"] = model.shap_error
+        payload["events"] = self.journal.stats()
         if self._last_reload is not None:
             payload["last_reload"] = self._last_reload
         payload["model"] = self.model_info

@@ -49,7 +49,11 @@ from cobalt_smart_lender_ai_tpu.reliability.errors import (
     RequestError,
     WorkerDead,
 )
-from cobalt_smart_lender_ai_tpu.telemetry import default_tracer, get_logger
+from cobalt_smart_lender_ai_tpu.telemetry import (
+    default_tracer,
+    event_context,
+    get_logger,
+)
 
 if TYPE_CHECKING:  # pragma: no cover - import cycle guard (replicas -> here)
     from cobalt_smart_lender_ai_tpu.serve.replicas import ReplicaSet
@@ -324,10 +328,20 @@ class FleetSupervisor:
             else:
                 h.probe_failures += 1
                 self._m_probes.labels(replica=str(i), outcome="failed").inc()
+                pf_eid = fleet.journal.emit(
+                    "supervisor",
+                    "probe_failure",
+                    replica=i,
+                    payload={
+                        "consecutive": h.probe_failures,
+                        "threshold": cfg.supervisor_probe_failures,
+                    },
+                )
                 if h.probe_failures >= cfg.supervisor_probe_failures:
                     self.quarantine(
                         i,
                         f"{h.probe_failures} consecutive smoke probes failed",
+                        cause_id=pf_eid,
                     )
                     summary["quarantined"] += 1
         return summary
@@ -364,14 +378,24 @@ class FleetSupervisor:
 
     # -- quarantine / heal -----------------------------------------------------
 
-    def quarantine(self, i: int, reason: str, *, manual: bool = False) -> dict:
+    def quarantine(
+        self,
+        i: int,
+        reason: str,
+        *,
+        manual: bool = False,
+        cause_id: int | None = None,
+    ) -> dict:
         """Evict replica ``i`` from routing (idempotent). Automatic
         quarantines heal on a later tick; manual ones wait for
-        ``POST /admin/readmit``."""
+        ``POST /admin/readmit``. ``cause_id`` chains the journal's
+        quarantine transition to its trigger (a probe-failure event)."""
         h = self.fleet.replica_health[i]
         if h.state in (QUARANTINED, RESTARTING):
             return {"status": h.state, "replica": i, "reason": h.reason}
-        self.fleet._note_transition(i, *h.to(QUARANTINED, reason, manual=manual))
+        self.fleet._note_transition(
+            i, *h.to(QUARANTINED, reason, manual=manual), cause_id=cause_id
+        )
         return {"status": QUARANTINED, "replica": i, "reason": reason}
 
     def heal(self, i: int) -> dict:
@@ -389,7 +413,16 @@ class FleetSupervisor:
             if h.state != QUARANTINED:
                 return {"status": h.state, "replica": i}
             started = h.quarantined_at or self._clock()
-            fleet._note_transition(i, *h.to(RESTARTING, "rebuilding replacement"))
+            # The causal spine of the heal: every downstream event chains
+            # back to the quarantine transition that triggered it, so the
+            # incident report reconstructs quarantine -> rebuild -> swap ->
+            # readmit from journal links alone.
+            quarantine_eid = fleet._last_transition_event.get(i)
+            fleet._note_transition(
+                i,
+                *h.to(RESTARTING, "rebuilding replacement"),
+                cause_id=quarantine_eid,
+            )
             old = fleet.replicas[i]
             drained = self._drain(i)
             try:
@@ -397,28 +430,56 @@ class FleetSupervisor:
                     replacement = self._rebuild(old)
             except Exception as exc:
                 self._m_rebuilds.labels(replica=str(i), outcome="failed").inc()
+                fleet.journal.emit(
+                    "supervisor",
+                    "rebuild",
+                    replica=i,
+                    payload={
+                        "outcome": "failed",
+                        "error": f"{type(exc).__name__}: {exc}",
+                    },
+                    cause_id=quarantine_eid,
+                )
                 fleet._note_transition(
                     i,
                     *h.to(
                         QUARANTINED,
                         f"rebuild failed: {type(exc).__name__}: {exc}",
                     ),
+                    cause_id=quarantine_eid,
                 )
                 return {"status": "rebuild_failed", "replica": i}
+            rebuild_eid = fleet.journal.emit(
+                "supervisor",
+                "rebuild",
+                replica=i,
+                payload={"outcome": "ok", "drained": drained},
+                cause_id=quarantine_eid,
+            )
             fleet._swap_replica(i, replacement)
+            swap_eid = fleet.journal.emit(
+                "supervisor",
+                "swap",
+                replica=i,
+                model=fleet._model_key,
+                cause_id=rebuild_eid,
+            )
             threading.Thread(
                 target=old.close, daemon=True, name=f"replica-reaper-{i}"
             ).start()
             self._m_rebuilds.labels(replica=str(i), outcome="ok").inc()
             heal_s = max(0.0, self._clock() - started)
             self._m_heal_s.labels(replica=str(i)).set(heal_s)
-            fleet._note_transition(
-                i, *h.to(HEALTHY, f"rebuilt and readmitted in {heal_s:.2f}s")
+            eid = fleet._note_transition(
+                i,
+                *h.to(HEALTHY, f"rebuilt and readmitted in {heal_s:.2f}s"),
+                cause_id=swap_eid,
             )
-            _LOG.info(
-                "replica_healed", replica=i, heal_s=round(heal_s, 3),
-                drained=drained,
-            )
+            with event_context(eid):
+                _LOG.info(
+                    "replica_healed", replica=i, heal_s=round(heal_s, 3),
+                    drained=drained,
+                )
             return {"status": "healed", "replica": i, "heal_s": heal_s}
 
     def _drain(self, i: int) -> bool:

@@ -64,6 +64,14 @@ from cobalt_smart_lender_ai_tpu.telemetry.drift import (
     FeatureSketch,
     psi,
 )
+from cobalt_smart_lender_ai_tpu.telemetry.events import (
+    EVENT_KINDS,
+    EventJournal,
+    current_event_id,
+    event_context,
+    load_events,
+    merge_events,
+)
 from cobalt_smart_lender_ai_tpu.telemetry.flight import (
     META_ROUTES,
     FlightRecorder,
@@ -126,6 +134,7 @@ from cobalt_smart_lender_ai_tpu.telemetry.tracing import (
 )
 
 __all__ = [
+    "EVENT_KINDS",
     "EXPOSITION_CONTENT_TYPE",
     "LATENCY_BUCKETS_S",
     "META_ROUTES",
@@ -133,6 +142,7 @@ __all__ = [
     "TRACE_CONTENT_TYPE",
     "Counter",
     "DeviceSampler",
+    "EventJournal",
     "FeatureSketch",
     "FlightRecorder",
     "Gauge",
@@ -150,8 +160,10 @@ __all__ = [
     "add_phase",
     "chrome_trace",
     "collect_phases",
+    "current_event_id",
     "current_request_id",
     "current_trace_ids",
+    "event_context",
     "default_device_sampler",
     "default_objectives",
     "default_program_registry",
@@ -163,9 +175,11 @@ __all__ = [
     "host_rss_bytes",
     "install_device_metrics",
     "install_program_metrics",
+    "load_events",
     "load_ledger",
     "load_segments",
     "log_buckets",
+    "merge_events",
     "merge_expositions",
     "merge_registries",
     "new_request_id",

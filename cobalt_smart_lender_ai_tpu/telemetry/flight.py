@@ -76,6 +76,7 @@ META_ROUTES: frozenset[str] = frozenset(
         "/debug/trace",
         "/debug/programs",
         "/history",
+        "/events",
         "/dashboard",
     }
 )

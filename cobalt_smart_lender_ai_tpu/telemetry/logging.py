@@ -127,6 +127,17 @@ class StructuredLogger:
             ids = current_trace_ids()
             if ids is not None:
                 record["trace_id"], record["span_id"] = ids
+        # Same deal for the control-plane event id: log lines written while
+        # an EventJournal emit's context is open carry the journal's join
+        # key, so logs/flight/traces/journal correlate on one id.
+        if "event_id" not in fields:
+            from cobalt_smart_lender_ai_tpu.telemetry.events import (
+                current_event_id,
+            )
+
+            eid = current_event_id()
+            if eid is not None:
+                record["event_id"] = eid
         record.update(fields)
         self._logger.log(
             level, json.dumps(record, default=_json_default, sort_keys=False)

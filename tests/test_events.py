@@ -1,0 +1,505 @@
+"""Fleet event journal + causal incident forensics (`telemetry/events.py`).
+
+Pins the PR's guarantees:
+
+- `EventJournal` is a bounded fake-clock ring: wraps evict oldest, drops
+  are counted only when the victim never shipped, unknown component/kind
+  pairs raise, and filters/`chain()` behave;
+- causal links survive a real supervisor heal: the rebuild/swap/readmit
+  events chain back to the quarantine that triggered them, so the
+  kill -> heal story is walkable from the journal alone;
+- ``GET /events`` works on the asyncio adapter (filters, typed 422s from
+  the shared validators) and on the stubbed FastAPI adapter;
+- durable shipping round-trips md5-pinned segments through
+  `FaultInjectingStore`: a failed put re-ships the same events, a torn
+  segment is skipped by `load_events`, never a crash;
+- journal events export as valid Perfetto instant events through
+  `chrome_trace`;
+- `tools/incident_report.py` renders the postmortem and its
+  ``--require-cause`` gate exits 0 / 4 / 2 correctly.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+# fixture re-export: the stubbed-fastapi harness (in-memory FastAPI/pydantic
+# doubles) lives with the adapter contract tests; /events only needs the
+# fixture itself
+from test_serve_fastapi_stub import fastapi_stubbed  # noqa: F401
+
+from cobalt_smart_lender_ai_tpu.config import ServeConfig
+from cobalt_smart_lender_ai_tpu.io import ObjectStore
+from cobalt_smart_lender_ai_tpu.reliability.faults import (
+    FaultInjectingStore,
+    FaultSpec,
+    InjectedFault,
+)
+from cobalt_smart_lender_ai_tpu.telemetry.events import (
+    EventJournal,
+    current_event_id,
+    event_context,
+    load_events,
+    merge_events,
+)
+
+
+class _Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _journal(capacity: int = 8, **kw) -> tuple[EventJournal, _Clock]:
+    clock = _Clock()
+    j = EventJournal(capacity=capacity, clock=clock, mono=clock, **kw)
+    return j, clock
+
+
+# --- ring discipline ----------------------------------------------------------
+
+
+def test_ring_bounds_and_drop_accounting():
+    j, clock = _journal(capacity=3)
+    ids = []
+    for n in range(5):
+        ids.append(j.emit("chaos", "inject", payload={"n": n}))
+        clock.advance(1.0)
+    stats = j.stats()
+    assert stats["depth"] == 3 and stats["capacity"] == 3
+    assert stats["emitted"] == 5
+    # two wraps, nothing ever shipped -> two dropped events
+    assert stats["dropped"] == 2
+    assert [e["payload"]["n"] for e in j.events()] == [2, 3, 4]
+    # ids are strictly increasing (process-wide mint)
+    assert ids == sorted(ids) and len(set(ids)) == 5
+
+
+def test_emit_rejects_unknown_taxonomy():
+    j, _ = _journal()
+    with pytest.raises(ValueError):
+        j.emit("supervisor", "no_such_kind")
+    with pytest.raises(ValueError):
+        j.emit("no_such_component", "transition")
+
+
+def test_filters_chain_and_context():
+    j, clock = _journal(capacity=16)
+    root = j.emit("supervisor", "probe_failure", replica=1)
+    clock.advance(5.0)
+    with event_context(root):
+        assert current_event_id() == root
+        mid = j.emit(
+            "supervisor",
+            "transition",
+            replica=1,
+            payload={"to": "quarantined"},
+        )
+    assert current_event_id() is None
+    leaf = j.emit("supervisor", "rebuild", replica=1, cause_id=mid)
+    j.emit("autoscaler", "resize", payload={"to": 2})
+
+    # ambient event_context stamped the cause_id
+    assert j.events(kind="transition")[0]["cause_id"] == root
+    # component/kind/since/limit filters
+    assert {e["component"] for e in j.events(component="autoscaler")} == {
+        "autoscaler"
+    }
+    assert [e["event_id"] for e in j.events(since=clock.t)] == [
+        mid,
+        leaf,
+        leaf + 1,
+    ]
+    assert len(j.events(limit=2)) == 2
+    # chain walks leaf -> root, returned root-first
+    assert [e["event_id"] for e in j.chain(leaf)] == [root, mid, leaf]
+
+
+def test_merge_events_totals_order():
+    a, _ = _journal()
+    b, _ = _journal()
+    ids = [
+        a.emit("chaos", "inject"),
+        b.emit("autoscaler", "resize", payload={"to": 2}),
+        a.emit("chaos", "inject"),
+    ]
+    merged = merge_events([a, b])
+    assert [e["event_id"] for e in merged] == sorted(ids)
+    assert [e["event_id"] for e in merge_events([a, b], limit=1)] == [ids[-1]]
+
+
+def test_metrics_family_and_readyz_block():
+    from cobalt_smart_lender_ai_tpu.telemetry import (
+        MetricsRegistry,
+        parse_exposition,
+    )
+
+    reg = MetricsRegistry()
+    j, _ = _journal(registry=reg)
+    j.emit("chaos", "inject")
+    j.emit("chaos", "inject")
+    j.emit("autoscaler", "retune")
+    text = reg.render()
+    parse_exposition(text)
+    assert 'cobalt_events_total{component="chaos",kind="inject"} 2' in text
+    assert "cobalt_events_ring_depth 3" in text
+
+
+# --- causal integrity under a real heal ---------------------------------------
+
+
+def _fleet_cfg(**kw) -> ServeConfig:
+    base = dict(
+        replicas=2,
+        microbatch_enabled=True,
+        precompile_batch_buckets=(),
+        prewarm_all_buckets=False,
+        score_cache_size=0,
+        supervisor_probe_deadline_s=0.3,
+        supervisor_probe_failures=1,
+        supervisor_drain_timeout_s=1.0,
+        replica_close_timeout_s=2.0,
+    )
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+@pytest.mark.slow
+def test_heal_chain_links_rebuild_to_quarantine(serving_artifact):
+    """After a chaos kill + supervisor heal, the journal alone tells the
+    story: quarantine -> restarting -> rebuild -> swap -> healthy, every
+    link via cause_id."""
+    from cobalt_smart_lender_ai_tpu.reliability import ChaosPlan
+    from cobalt_smart_lender_ai_tpu.serve.replicas import ReplicaSet
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+    from cobalt_smart_lender_ai_tpu.serve.supervisor import HEALTHY
+
+    store, _ = serving_artifact
+    cfg = _fleet_cfg()
+    fleet = ReplicaSet(
+        [ScorerService.from_store(store, cfg) for _ in range(2)], cfg
+    )
+    try:
+        plan = ChaosPlan(seed=3, registry=fleet.registry)
+        plan.add_latency(replica=1, delay_s=0.001, max_events=1)
+        plan.inject(fleet)
+        plan._on_dispatch(1)  # one fault through the chaos checkpoint
+        fleet.supervisor.quarantine(1, "test chaos", manual=False)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            fleet.supervisor.tick()
+            if fleet.replica_health[1].state == HEALTHY:
+                break
+            time.sleep(0.05)
+        assert fleet.replica_health[1].state == HEALTHY
+        plan.release()
+
+        events = fleet.events(component="supervisor")
+        by_kind = {}
+        for e in events:
+            if e["replica"] == 1:
+                by_kind.setdefault(
+                    (e["kind"], (e["payload"] or {}).get("to")), e
+                )
+        quarantine = by_kind[("transition", "quarantined")]
+        rebuild = by_kind[("rebuild", None)]
+        swap = by_kind[("swap", None)]
+        healthy = by_kind[("transition", "healthy")]
+        # the acceptance chain: rebuild links to its quarantine, swap to
+        # the rebuild, readmission to the swap
+        assert rebuild["cause_id"] == quarantine["event_id"]
+        assert swap["cause_id"] == rebuild["event_id"]
+        assert healthy["cause_id"] == swap["event_id"]
+        # chaos hang landed in the merged journal too
+        assert any(
+            e["component"] == "chaos" for e in fleet.events()
+        )
+        # the gated kind always carries a cause snapshot
+        assert quarantine["cause"]
+    finally:
+        fleet.close()
+
+
+# --- /events over HTTP --------------------------------------------------------
+
+
+def _get(url: str):
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+@pytest.mark.slow
+def test_events_route_asyncio_filters_and_422(serving_artifact):
+    from cobalt_smart_lender_ai_tpu.serve.http_asyncio import (
+        make_async_server,
+    )
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(
+        store, ServeConfig(prewarm_all_buckets=False)
+    )
+    server = make_async_server(svc)
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        svc.journal.emit("reload", "publish", model="m1")
+        svc.journal.emit("breaker", "open")
+        status, doc = _get(url + "/events")
+        assert status == 200
+        assert doc["count"] == len(doc["events"]) >= 2
+        assert doc["stats"]["depth"] >= 2
+        status, doc = _get(url + "/events?component=breaker")
+        assert status == 200
+        assert {e["component"] for e in doc["events"]} == {"breaker"}
+        status, doc = _get(url + "/events?component=reload&kind=publish")
+        assert doc["events"][0]["model"] == "m1"
+        status, doc = _get(url + "/events?limit=1")
+        assert doc["count"] == 1
+
+        # typed 422s from the shared validators
+        for bad in (
+            "/events?component=nope",
+            "/events?kind=nope",
+            "/events?component=breaker&kind=publish",
+            "/events?since=abc",
+            "/events?limit=-2",
+        ):
+            status, doc = _get(url + bad)
+            assert status == 422, bad
+            assert doc["error"] == "invalid_input", bad
+    finally:
+        server.close()
+        svc.close()
+
+
+def test_events_route_fastapi_stub(fastapi_stubbed, serving_artifact):
+    from cobalt_smart_lender_ai_tpu.serve.http_fastapi import create_app
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(
+        store, ServeConfig(prewarm_all_buckets=False)
+    )
+    try:
+        app = create_app(service=svc)
+        svc.journal.emit("canary", "promote", model="v2")
+        doc = app.get_routes["/events"]()
+        assert doc["count"] >= 1
+        assert any(e["component"] == "canary" for e in doc["events"])
+        doc = app.get_routes["/events"](component="canary", kind="promote")
+        assert doc["events"][-1]["model"] == "v2"
+        with pytest.raises(fastapi_stubbed.HTTPException) as ei:
+            app.get_routes["/events"](component="nope")
+        assert ei.value.status_code == 422
+    finally:
+        svc.close()
+
+
+def test_readyz_carries_events_block(fastapi_stubbed, serving_artifact):
+    from cobalt_smart_lender_ai_tpu.serve.http_fastapi import create_app
+    from cobalt_smart_lender_ai_tpu.serve.service import ScorerService
+
+    store, _ = serving_artifact
+    svc = ScorerService.from_store(
+        store, ServeConfig(prewarm_all_buckets=False)
+    )
+    try:
+        app = create_app(service=svc)
+        svc.journal.emit("reload", "publish")
+        ready = app.get_routes["/readyz"]()
+        assert ready["events"]["depth"] >= 1
+        assert ready["events"]["shipping"]["enabled"] is False
+    finally:
+        svc.close()
+
+
+# --- durable segments ---------------------------------------------------------
+
+
+def test_durable_ship_and_load_round_trip(tmp_path):
+    store = ObjectStore(str(tmp_path / "lake"))
+    j, clock = _journal(capacity=4, store=store, ship_interval_s=0)
+    ids = [j.emit("chaos", "inject", payload={"n": n}) for n in range(3)]
+    key = j.ship()
+    assert key and store.verify_pointer(key)
+    assert j.ship() is None  # nothing new
+    # wrap past capacity: shipped events evict without counting as drops
+    ids += [j.emit("chaos", "inject", payload={"n": n}) for n in range(3, 8)]
+    assert j.stats()["dropped"] == 1  # only the one unshipped victim
+    j.ship()
+    loaded = load_events(store)
+    assert [e["event_id"] for e in loaded] == sorted(
+        set(e for e in ids) - {ids[3]}
+    )
+    assert j.stats()["shipping"]["segments"] == 2
+
+
+def test_ship_failure_reships_same_events(tmp_path):
+    inner = ObjectStore(str(tmp_path / "lake"))
+    flaky = FaultInjectingStore(
+        inner, seed=0, faults={"put": FaultSpec(fail_after=0, max_faults=1)}
+    )
+    j, _ = _journal(capacity=8, store=flaky, ship_interval_s=0)
+    ids = [j.emit("autoscaler", "retune", payload={"n": n}) for n in range(2)]
+    with pytest.raises(InjectedFault):
+        j.ship()
+    # high-water mark did not advance past the failed write
+    assert j.stats()["shipping"]["shipped_until_id"] == 0
+    key = j.ship()  # budget spent: this one lands
+    assert key is not None
+    assert [e["event_id"] for e in load_events(flaky)] == ids
+
+
+def test_torn_segment_skipped_by_loader(tmp_path):
+    store = ObjectStore(str(tmp_path / "lake"))
+    j, _ = _journal(capacity=8, store=store, ship_interval_s=0)
+    j.emit("breaker", "open")
+    torn = j.ship()
+    j.emit("breaker", "close")
+    good = j.ship()
+    # tear the first segment after its pointer was pinned
+    store.put_bytes(torn, b'{"schema": 1, "seq": 1, "events": [')
+    loaded = load_events(store)
+    assert [e["kind"] for e in loaded] == ["close"]
+    assert good != torn
+
+
+def test_stop_does_final_ship(tmp_path):
+    store = ObjectStore(str(tmp_path / "lake"))
+    j, _ = _journal(capacity=8, store=store, ship_interval_s=3600.0)
+    j.start()
+    j.emit("reload", "rollback", cause={"error": "boom"})
+    j.stop()
+    assert [e["kind"] for e in load_events(store)] == ["rollback"]
+
+
+# --- Perfetto export ----------------------------------------------------------
+
+
+def test_chrome_trace_journal_instant_events():
+    from cobalt_smart_lender_ai_tpu.telemetry.traceexport import chrome_trace
+
+    j, clock = _journal(capacity=8)
+    eid = j.emit("autoscaler", "brownout", payload={"level": 2})
+    j.emit("supervisor", "swap", replica=1, cause_id=eid)
+    doc = chrome_trace(journal=j)
+    instants = [e for e in doc["traceEvents"] if e.get("cat") == "event"]
+    assert len(instants) == 2
+    for ev in instants:
+        assert ev["ph"] == "i" and ev["s"] == "p"
+        assert isinstance(ev["ts"], float) and ev["ts"] >= 0
+        assert "event_id" in ev["args"]
+    names = {e["name"] for e in instants}
+    assert names == {"autoscaler.brownout", "supervisor.swap"}
+    assert instants[1]["args"]["cause_id"] == eid
+    assert doc["otherData"]["journal_event_count"] == 2
+    json.dumps(doc)  # must remain JSON-serializable
+
+
+# --- incident_report tool -----------------------------------------------------
+
+_TOOL = str(
+    Path(__file__).resolve().parent.parent / "tools" / "incident_report.py"
+)
+
+
+def _run_tool(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, _TOOL, *args], capture_output=True, text=True
+    )
+
+
+def _bench_doc(journal: EventJournal) -> dict:
+    return {
+        "bench": "serve_chaos",
+        "load": {"requests": 10, "errors": 0, "untyped_errors": 0,
+                 "p99_ms": 4.2},
+        "events": {"journal": journal.events(), "stats": journal.stats()},
+    }
+
+
+def test_incident_report_renders_chain_and_passes_gate(tmp_path):
+    j, clock = _journal(capacity=32)
+    kill = j.emit("chaos", "inject", replica=1, payload={"fault": "kill"},
+                  cause={"plan": "chaos"})
+    clock.advance(0.5)
+    pf = j.emit("supervisor", "probe_failure", replica=1,
+                payload={"consecutive": 1})
+    q = j.emit("supervisor", "transition", replica=1,
+               payload={"from": "healthy", "to": "quarantined"},
+               cause={"reason": "probe"}, cause_id=pf)
+    clock.advance(1.0)
+    rb = j.emit("supervisor", "rebuild", replica=1,
+                payload={"outcome": "ok"}, cause_id=q)
+    sw = j.emit("supervisor", "swap", replica=1, cause_id=rb)
+    j.emit("supervisor", "transition", replica=1,
+           payload={"from": "restarting", "to": "healthy"}, cause_id=sw)
+    bench = tmp_path / "bench.json"
+    bench.write_text(json.dumps(_bench_doc(j)))
+    out = tmp_path / "incident.md"
+    proc = _run_tool("--bench", str(bench), "--require-cause",
+                     "--out", str(out))
+    assert proc.returncode == 0, proc.stderr
+    report = out.read_text()
+    assert "time to healthy: **1.000s**" in report
+    assert "suspected trigger: `chaos.inject`" in report
+    assert "orphans (no cause, no cause_id): 0" in report
+    # --window keeps only the heal tail
+    proc = _run_tool("--bench", str(bench), "--window", "0.6:")
+    assert proc.returncode == 0
+    assert "chaos.inject" not in proc.stdout.split("## Incidents")[1]
+
+
+def test_incident_report_require_cause_orphan_exits_4(tmp_path):
+    j, _ = _journal(capacity=8)
+    j.emit("autoscaler", "resize", payload={"direction": "up", "to": 2})
+    bench = tmp_path / "bench.json"
+    bench.write_text(json.dumps(_bench_doc(j)))
+    proc = _run_tool("--bench", str(bench), "--require-cause")
+    assert proc.returncode == 4
+    assert "orphan" in proc.stderr
+    # without the gate the same input renders fine
+    assert _run_tool("--bench", str(bench)).returncode == 0
+
+
+def test_incident_report_unreadable_input_exits_2(tmp_path):
+    assert _run_tool("--bench", str(tmp_path / "nope.json")).returncode == 2
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}")
+    assert _run_tool("--bench", str(empty)).returncode == 2
+
+
+# --- log lines carry event_id -------------------------------------------------
+
+
+def test_structured_logs_stamp_event_id(caplog):
+    import logging
+
+    from cobalt_smart_lender_ai_tpu.telemetry.logging import get_logger
+
+    log = get_logger("test.events")
+    with caplog.at_level(logging.INFO, logger="cobalt.test.events"):
+        with event_context(77):
+            log.info("inside_context")
+        log.info("outside_context")
+    inside = json.loads(caplog.records[0].getMessage())
+    outside = json.loads(caplog.records[1].getMessage())
+    assert inside["event"] == "inside_context"
+    assert inside["event_id"] == 77
+    assert "event_id" not in outside

@@ -225,6 +225,7 @@ def test_fastapi_adapter_routes_execute(fastapi_stubbed, serving_artifact):
         "/debug/trace",
         "/debug/programs",
         "/history",
+        "/events",
         "/dashboard",
     }
 
